@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device CPU platform so sharding tests can
+exercise real multi-device meshes without TPU hardware (the driver dry-runs
+the multi-chip path the same way)."""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(params=[False, True], ids=["batching_on", "batching_off"])
+def toggle_batching(request):
+    """Run a test under both batching modes (reference tests/conftest.py:15-18)."""
+    from tpusnap.knobs import override_batching_disabled
+
+    with override_batching_disabled(request.param):
+        yield request.param

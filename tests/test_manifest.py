@@ -1,0 +1,149 @@
+"""Manifest model tests, mirroring the reference's
+tests/test_manifest.py:38-120 round-trip coverage."""
+
+import math
+
+from tpusnap.manifest import (
+    Chunk,
+    ChunkedTensorEntry,
+    DictEntry,
+    ListEntry,
+    ObjectEntry,
+    OrderedDictEntry,
+    PrimitiveEntry,
+    Shard,
+    ShardedEntry,
+    SnapshotMetadata,
+    TensorEntry,
+    TupleEntry,
+    is_container_entry,
+    is_replicated,
+)
+
+
+def _sample_manifest():
+    return {
+        "0/model": DictEntry(keys=["w", "b", 7]),
+        "0/model/w": TensorEntry(
+            location="0/model/w",
+            serializer="buffer_protocol",
+            dtype="bfloat16",
+            shape=[128, 256],
+            replicated=False,
+        ),
+        "0/model/b": TensorEntry(
+            location="batched/abc",
+            serializer="buffer_protocol",
+            dtype="float32",
+            shape=[256],
+            replicated=True,
+            byte_range=[0, 1024],
+        ),
+        "0/model/7": PrimitiveEntry.from_object(3.14159),
+        "0/opt": TupleEntry(),
+        "0/opt/0": ObjectEntry(
+            location="0/opt/0",
+            serializer="pickle",
+            obj_type="ScaleByAdamState",
+            replicated=False,
+        ),
+        "0/big": ChunkedTensorEntry(
+            dtype="float32",
+            shape=[1000, 10],
+            chunks=[
+                Chunk(
+                    offsets=[0, 0],
+                    sizes=[500, 10],
+                    tensor=TensorEntry(
+                        location="0/big_0_0",
+                        serializer="buffer_protocol",
+                        dtype="float32",
+                        shape=[500, 10],
+                        replicated=False,
+                    ),
+                )
+            ],
+            replicated=False,
+        ),
+        "sharded/emb": ShardedEntry(
+            shards=[
+                Shard(
+                    offsets=[0, 0],
+                    sizes=[512, 64],
+                    tensor=TensorEntry(
+                        location="sharded/emb_0",
+                        serializer="buffer_protocol",
+                        dtype="float32",
+                        shape=[512, 64],
+                        replicated=False,
+                    ),
+                ),
+                Shard(
+                    offsets=[512, 0],
+                    sizes=[512, 64],
+                    tensor=TensorEntry(
+                        location="sharded/emb_1",
+                        serializer="buffer_protocol",
+                        dtype="float32",
+                        shape=[512, 64],
+                        replicated=False,
+                    ),
+                ),
+            ]
+        ),
+        "0/list": ListEntry(),
+        "0/od": OrderedDictEntry(keys=["x"]),
+    }
+
+
+def test_metadata_yaml_roundtrip():
+    md = SnapshotMetadata(version="0.1.0", world_size=4, manifest=_sample_manifest())
+    s = md.to_yaml()
+    md2 = SnapshotMetadata.from_yaml(s)
+    assert md2.version == "0.1.0"
+    assert md2.world_size == 4
+    assert set(md2.manifest.keys()) == set(md.manifest.keys())
+    for k in md.manifest:
+        assert md.manifest[k] == md2.manifest[k], k
+
+
+def test_primitive_float_bit_exact():
+    for val in [0.1, math.pi, 1e-300, -0.0, 3.0]:
+        e = PrimitiveEntry.from_object(val)
+        roundtripped = e.get_value()
+        assert math.copysign(1, roundtripped) == math.copysign(1, val)
+        assert roundtripped == val or (math.isnan(val) and math.isnan(roundtripped))
+        # bit-exactness via struct pack equality
+        import struct
+
+        assert struct.pack("<d", roundtripped) == struct.pack("<d", val)
+
+
+def test_primitive_types():
+    assert PrimitiveEntry.from_object(42).get_value() == 42
+    assert PrimitiveEntry.from_object(True).get_value() is True
+    assert PrimitiveEntry.from_object(False).get_value() is False
+    assert PrimitiveEntry.from_object("hi/there%42").get_value() == "hi/there%42"
+    assert PrimitiveEntry.from_object(b"\x00\xffbin").get_value() == b"\x00\xffbin"
+    assert PrimitiveEntry.supported(1)
+    assert PrimitiveEntry.supported("x")
+    assert not PrimitiveEntry.supported([1])
+    assert not PrimitiveEntry.supported(None)
+
+
+def test_sharded_entry_infers_global_shape():
+    e = _sample_manifest()["sharded/emb"]
+    assert e.shape == [1024, 64]
+    assert e.dtype == "float32"
+
+
+def test_is_replicated_and_container():
+    m = _sample_manifest()
+    assert is_replicated(m["0/model/b"])
+    assert not is_replicated(m["0/model/w"])
+    assert not is_replicated(m["0/model"])
+    assert is_container_entry(m["0/model"])
+    assert is_container_entry(m["0/opt"])
+    assert is_container_entry(m["0/list"])
+    assert is_container_entry(m["0/od"])
+    assert not is_container_entry(m["0/model/w"])

@@ -1,0 +1,34 @@
+"""tpusnap — a TPU-native checkpointing framework for JAX/XLA workloads.
+
+Built from scratch with the capability set of torchsnapshot (see SURVEY.md):
+memory-efficient, pipelined, distributed snapshots of app-state pytrees with
+automatic resharding across mesh/world-size changes.
+"""
+
+from .version import __version__  # noqa: F401
+
+# Populated as layers land; the full export set mirrors the reference's
+# torchsnapshot/__init__.py:35-41.
+__all__ = ["__version__"]
+
+try:  # pragma: no cover - import surface grows as modules land
+    from .stateful import AppState, Stateful  # noqa: F401
+    from .state_dict import StateDict  # noqa: F401
+    from .rng_state import RNGState  # noqa: F401
+    from .pytree_state import PytreeState  # noqa: F401
+    from .snapshot import PendingSnapshot, Snapshot  # noqa: F401
+
+    __all__ += [
+        "Snapshot",
+        "PendingSnapshot",
+        "Stateful",
+        "AppState",
+        "StateDict",
+        "RNGState",
+        "PytreeState",
+    ]
+except ModuleNotFoundError as e:  # modules not created yet during bootstrap
+    # Only swallow "tpusnap.X does not exist yet"; a failure inside an
+    # existing submodule (or a missing third-party dep) must propagate.
+    if not (e.name or "").startswith("tpusnap"):
+        raise

@@ -1,0 +1,115 @@
+"""Tunable knobs, each an env var with a context-manager override for tests.
+
+TPU-native counterpart of the reference's knob system
+(/root/reference/torchsnapshot/knobs.py:21-96). Defaults match the
+reference: 512MB max chunk, 512MB max shard, 128MB slab threshold.
+"""
+
+import contextlib
+import logging
+import os
+from typing import Generator, Optional
+
+logger = logging.getLogger(__name__)
+
+_MAX_CHUNK_SIZE_ENV_VAR = "TPUSNAP_MAX_CHUNK_SIZE_BYTES"
+_MAX_SHARD_SIZE_ENV_VAR = "TPUSNAP_MAX_SHARD_SIZE_BYTES"
+_SLAB_SIZE_THRESHOLD_ENV_VAR = "TPUSNAP_SLAB_SIZE_THRESHOLD_BYTES"
+_DISABLE_BATCHING_ENV_VAR = "TPUSNAP_DISABLE_BATCHING"
+_DISABLE_PARTITIONER_ENV_VAR = "TPUSNAP_DISABLE_PARTITIONER"
+_MEMORY_BUDGET_ENV_VAR = "TPUSNAP_MAX_PER_RANK_MEMORY_BUDGET_BYTES"
+_DISABLE_NATIVE_ENV_VAR = "TPUSNAP_DISABLE_NATIVE"
+
+_DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
+_DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
+_DEFAULT_SLAB_SIZE_THRESHOLD_BYTES = 128 * 1024 * 1024
+
+
+def _get_int_env(name: str, default: int) -> int:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        logger.warning("Ignoring non-integer %s=%r", name, val)
+        return default
+
+
+def get_max_chunk_size_bytes() -> int:
+    return _get_int_env(_MAX_CHUNK_SIZE_ENV_VAR, _DEFAULT_MAX_CHUNK_SIZE_BYTES)
+
+
+def get_max_shard_size_bytes() -> int:
+    return _get_int_env(_MAX_SHARD_SIZE_ENV_VAR, _DEFAULT_MAX_SHARD_SIZE_BYTES)
+
+
+def get_slab_size_threshold_bytes() -> int:
+    return _get_int_env(
+        _SLAB_SIZE_THRESHOLD_ENV_VAR, _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES
+    )
+
+
+def is_batching_disabled() -> bool:
+    return os.environ.get(_DISABLE_BATCHING_ENV_VAR, "0") == "1"
+
+
+def is_partitioner_disabled() -> bool:
+    return os.environ.get(_DISABLE_PARTITIONER_ENV_VAR, "0") == "1"
+
+
+def is_native_disabled() -> bool:
+    return os.environ.get(_DISABLE_NATIVE_ENV_VAR, "0") == "1"
+
+
+def get_memory_budget_override_bytes() -> Optional[int]:
+    if _MEMORY_BUDGET_ENV_VAR not in os.environ:
+        return None
+    val = _get_int_env(_MEMORY_BUDGET_ENV_VAR, -1)
+    return val if val > 0 else None
+
+
+@contextlib.contextmanager
+def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
+    prev = os.environ.get(name)
+    try:
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = value
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
+
+
+@contextlib.contextmanager
+def override_max_chunk_size_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_MAX_CHUNK_SIZE_ENV_VAR, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_max_shard_size_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_MAX_SHARD_SIZE_ENV_VAR, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_slab_size_threshold_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_SLAB_SIZE_THRESHOLD_ENV_VAR, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_batching_disabled(disabled: bool) -> Generator[None, None, None]:
+    with _override_env(_DISABLE_BATCHING_ENV_VAR, "1" if disabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_memory_budget_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_MEMORY_BUDGET_ENV_VAR, str(nbytes)):
+        yield

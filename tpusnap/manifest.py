@@ -1,0 +1,408 @@
+"""Typed manifest model describing everything persisted in a snapshot.
+
+TPU-native counterpart of the reference's manifest
+(/root/reference/torchsnapshot/manifest.py:28-329). Same taxonomy:
+
+- ``TensorEntry``     — one dense array blob (location, serializer, dtype,
+                        shape, replicated flag, optional byte range when the
+                        blob lives inside a batched slab).
+- ``ShardedEntry``    — an array sharded over a device mesh; a list of
+                        ``Shard{offsets, sizes, tensor}``. In JAX this covers
+                        DP/FSDP/TP/SP/EP uniformly: any
+                        ``jax.sharding.NamedSharding`` reduces to per-shard
+                        offsets/sizes in the global shape.
+- ``ChunkedTensorEntry`` — one large array split into ≤max_chunk_size chunks
+                        along dim 0 for pipelined DtoH/IO.
+- ``ObjectEntry``     — arbitrary pickled object blob.
+- ``PrimitiveEntry``  — int/str/bool/float/bytes inlined into the metadata
+                        (floats bit-exact via base64-packed C double, same
+                        trick as reference manifest.py:187-270).
+- ``DictEntry`` / ``ListEntry`` / ``OrderedDictEntry`` — containers, so the
+  original nesting can be rebuilt on restore.
+
+``SnapshotMetadata`` is serialized as JSON (a subset of YAML — same speed
+trick as reference manifest.py:283-289) and parsed with json-first,
+yaml-fallback.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import yaml
+
+Manifest = Dict[str, "Entry"]
+
+
+@dataclass
+class Entry:
+    """Base for all manifest entries; ``type`` is the tagged-union key."""
+
+    type: str
+
+
+@dataclass
+class TensorEntry(Entry):
+    location: str
+    serializer: str
+    dtype: str
+    shape: List[int]
+    replicated: bool
+    byte_range: Optional[List[int]] = None  # [start, end) within location
+
+    def __init__(
+        self,
+        location: str,
+        serializer: str,
+        dtype: str,
+        shape: Sequence[int],
+        replicated: bool,
+        byte_range: Optional[Sequence[int]] = None,
+    ) -> None:
+        super().__init__(type="Tensor")
+        self.location = location
+        self.serializer = serializer
+        self.dtype = dtype
+        self.shape = list(shape)
+        self.replicated = replicated
+        self.byte_range = list(byte_range) if byte_range is not None else None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TensorEntry":
+        return cls(
+            location=d["location"],
+            serializer=d["serializer"],
+            dtype=d["dtype"],
+            shape=d["shape"],
+            replicated=d["replicated"],
+            byte_range=d.get("byte_range"),
+        )
+
+
+@dataclass
+class Shard:
+    offsets: List[int]
+    sizes: List[int]
+    tensor: TensorEntry
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Shard":
+        return cls(
+            offsets=list(d["offsets"]),
+            sizes=list(d["sizes"]),
+            tensor=TensorEntry.from_dict(d["tensor"]),
+        )
+
+
+@dataclass
+class ShardedEntry(Entry):
+    shards: List[Shard]
+    dtype: str = ""
+    shape: List[int] = field(default_factory=list)
+
+    def __init__(
+        self,
+        shards: List[Shard],
+        dtype: str = "",
+        shape: Optional[Sequence[int]] = None,
+    ) -> None:
+        super().__init__(type="Sharded")
+        self.shards = shards
+        self.dtype = dtype or (shards[0].tensor.dtype if shards else "")
+        if shape is not None:
+            self.shape = list(shape)
+        elif shards:
+            # Global shape inferred as the max extent covered by any shard.
+            ndim = len(shards[0].offsets)
+            self.shape = [
+                max(s.offsets[d] + s.sizes[d] for s in shards) for d in range(ndim)
+            ]
+        else:
+            self.shape = []
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ShardedEntry":
+        return cls(
+            shards=[Shard.from_dict(s) for s in d["shards"]],
+            dtype=d.get("dtype", ""),
+            shape=d.get("shape"),
+        )
+
+
+@dataclass
+class Chunk:
+    offsets: List[int]
+    sizes: List[int]
+    tensor: TensorEntry
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Chunk":
+        return cls(
+            offsets=list(d["offsets"]),
+            sizes=list(d["sizes"]),
+            tensor=TensorEntry.from_dict(d["tensor"]),
+        )
+
+
+@dataclass
+class ChunkedTensorEntry(Entry):
+    dtype: str
+    shape: List[int]
+    chunks: List[Chunk]
+    replicated: bool
+
+    def __init__(
+        self,
+        dtype: str,
+        shape: Sequence[int],
+        chunks: List[Chunk],
+        replicated: bool,
+    ) -> None:
+        super().__init__(type="ChunkedTensor")
+        self.dtype = dtype
+        self.shape = list(shape)
+        self.chunks = chunks
+        self.replicated = replicated
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ChunkedTensorEntry":
+        return cls(
+            dtype=d["dtype"],
+            shape=d["shape"],
+            chunks=[Chunk.from_dict(c) for c in d["chunks"]],
+            replicated=d["replicated"],
+        )
+
+
+@dataclass
+class ObjectEntry(Entry):
+    location: str
+    serializer: str
+    obj_type: str
+    replicated: bool
+
+    def __init__(
+        self, location: str, serializer: str, obj_type: str, replicated: bool
+    ) -> None:
+        super().__init__(type="object")
+        self.location = location
+        self.serializer = serializer
+        self.obj_type = obj_type
+        self.replicated = replicated
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ObjectEntry":
+        return cls(
+            location=d["location"],
+            serializer=d["serializer"],
+            obj_type=d["obj_type"],
+            replicated=d["replicated"],
+        )
+
+
+@dataclass
+class ListEntry(Entry):
+    def __init__(self) -> None:
+        super().__init__(type="list")
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ListEntry":
+        return cls()
+
+
+@dataclass
+class TupleEntry(Entry):
+    """JAX extension: optax/flax pytrees are full of tuples/NamedTuples;
+    the reference would have pickled them whole (io_preparer.py:125). We
+    flatten them like lists and rebuild a tuple on inflate."""
+
+    def __init__(self) -> None:
+        super().__init__(type="tuple")
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TupleEntry":
+        return cls()
+
+
+@dataclass
+class DictEntry(Entry):
+    keys: List[Union[str, int]]
+
+    def __init__(self, keys: List[Union[str, int]]) -> None:
+        super().__init__(type="dict")
+        self.keys = keys
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DictEntry":
+        return cls(keys=d["keys"])
+
+
+@dataclass
+class OrderedDictEntry(Entry):
+    keys: List[Union[str, int]]
+
+    def __init__(self, keys: List[Union[str, int]]) -> None:
+        super().__init__(type="OrderedDict")
+        self.keys = keys
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OrderedDictEntry":
+        return cls(keys=d["keys"])
+
+
+@dataclass
+class PrimitiveEntry(Entry):
+    """int/float/bool/str/bytes inlined directly into the metadata.
+
+    Floats are stored bit-exactly: base64 of the IEEE-754 double, matching
+    the reference's readable=False float path (manifest.py:221-245).
+    """
+
+    dtype: str
+    layout: str
+    serialized_value: str
+    replicated: bool
+
+    def __init__(
+        self, dtype: str, layout: str, serialized_value: str, replicated: bool
+    ) -> None:
+        super().__init__(type="primitive")
+        self.dtype = dtype
+        self.layout = layout
+        self.serialized_value = serialized_value
+        self.replicated = replicated
+
+    SUPPORTED_TYPES = (int, float, bool, str, bytes)
+
+    @classmethod
+    def supported(cls, obj: Any) -> bool:
+        # bool is a subclass of int; keep explicit for clarity.
+        return type(obj) in cls.SUPPORTED_TYPES
+
+    @classmethod
+    def from_object(cls, obj: Any, replicated: bool = False) -> "PrimitiveEntry":
+        t = type(obj)
+        if t is int:
+            return cls("int", "text", str(obj), replicated)
+        if t is bool:
+            return cls("bool", "text", str(obj), replicated)
+        if t is str:
+            return cls("str", "text", obj, replicated)
+        if t is float:
+            packed = base64.b64encode(struct.pack("<d", obj)).decode("ascii")
+            return cls("float", "b64_le_f64", packed, replicated)
+        if t is bytes:
+            return cls("bytes", "b64", base64.b64encode(obj).decode("ascii"), replicated)
+        raise TypeError(f"Unsupported primitive type: {t}")
+
+    def get_value(self) -> Any:
+        if self.dtype == "int":
+            return int(self.serialized_value)
+        if self.dtype == "bool":
+            return self.serialized_value == "True"
+        if self.dtype == "str":
+            return self.serialized_value
+        if self.dtype == "float":
+            if self.layout == "b64_le_f64":
+                return struct.unpack("<d", base64.b64decode(self.serialized_value))[0]
+            return float(self.serialized_value)
+        if self.dtype == "bytes":
+            return base64.b64decode(self.serialized_value)
+        raise TypeError(f"Unsupported primitive dtype: {self.dtype}")
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PrimitiveEntry":
+        return cls(
+            dtype=d["dtype"],
+            layout=d["layout"],
+            serialized_value=d["serialized_value"],
+            replicated=d["replicated"],
+        )
+
+
+_ENTRY_TYPES = {
+    "Tensor": TensorEntry,
+    "Sharded": ShardedEntry,
+    "ChunkedTensor": ChunkedTensorEntry,
+    "object": ObjectEntry,
+    "list": ListEntry,
+    "tuple": TupleEntry,
+    "dict": DictEntry,
+    "OrderedDict": OrderedDictEntry,
+    "primitive": PrimitiveEntry,
+}
+
+
+def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
+    def convert(v: Any) -> Any:
+        if isinstance(v, (Shard, Chunk)):
+            return {
+                "offsets": v.offsets,
+                "sizes": v.sizes,
+                "tensor": _entry_to_dict(v.tensor),
+            }
+        if isinstance(v, Entry):
+            return _entry_to_dict(v)
+        if isinstance(v, list):
+            return [convert(x) for x in v]
+        return v
+
+    # The "type" tag rides along in entry.__dict__ and is what
+    # entry_from_dict dispatches on.
+    return {k: convert(v) for k, v in entry.__dict__.items() if v is not None}
+
+
+def entry_from_dict(d: Dict[str, Any]) -> Entry:
+    type_ = d["type"]
+    if type_ not in _ENTRY_TYPES:
+        raise ValueError(f"Unknown entry type: {type_}")
+    body = {k: v for k, v in d.items() if k != "type"}
+    return _ENTRY_TYPES[type_].from_dict(body)
+
+
+def is_replicated(entry: Entry) -> bool:
+    """Mirror of reference manifest.py:321-325."""
+    return (
+        isinstance(entry, (TensorEntry, ChunkedTensorEntry, ObjectEntry, PrimitiveEntry))
+        and entry.replicated
+    )
+
+
+def is_container_entry(entry: Entry) -> bool:
+    return isinstance(entry, (ListEntry, TupleEntry, DictEntry, OrderedDictEntry))
+
+
+@dataclass
+class SnapshotMetadata:
+    version: str
+    world_size: int
+    manifest: Manifest
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": self.version,
+            "world_size": self.world_size,
+            "manifest": {k: _entry_to_dict(v) for k, v in self.manifest.items()},
+        }
+
+    def to_yaml(self) -> str:
+        # JSON is a subset of YAML; json.dumps is much faster than yaml.dump
+        # for large manifests (reference manifest.py:283-289).
+        return json.dumps(self.to_dict(), sort_keys=False)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SnapshotMetadata":
+        manifest = {k: entry_from_dict(v) for k, v in d["manifest"].items()}
+        return cls(version=d["version"], world_size=d["world_size"], manifest=manifest)
+
+    @classmethod
+    def from_yaml(cls, s: str) -> "SnapshotMetadata":
+        try:
+            d = json.loads(s)
+        except json.JSONDecodeError:
+            d = yaml.safe_load(s)
+        return cls.from_dict(d)

@@ -1,0 +1,3 @@
+# Version of the tpusnap snapshot format written to SnapshotMetadata.
+# Mirrors the role of the reference's torchsnapshot/version.py:17.
+__version__ = "0.1.0"
