@@ -131,3 +131,12 @@ def test_missing_leaf_with_tuple_in_list_compacts():
     m, f = flatten({"l": [1, (2,), 3]}, prefix="r")
     del f["r/l/0"]
     assert inflate(m, f, prefix="r") == {"l": [(2,), 3]}
+
+
+def test_inflate_drops_keys_absent_from_container_entry():
+    # The container entry is the source of truth for dict membership.
+    from tpusnap.manifest import DictEntry
+
+    m = {"r": DictEntry(keys=["a"])}
+    f = {"r/a": 1, "r/b": 2}
+    assert inflate(m, f, prefix="r") == {"a": 1}

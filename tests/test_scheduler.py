@@ -1,0 +1,203 @@
+"""Scheduler pipeline tests: budget gating, overlap, failure propagation."""
+
+import asyncio
+import os
+
+import pytest
+
+from tpusnap.io_types import (
+    BufferConsumer,
+    BufferStager,
+    ReadReq,
+    WriteReq,
+)
+from tpusnap.knobs import override_memory_budget_bytes
+from tpusnap.scheduler import (
+    PendingIOWork,
+    execute_read_reqs,
+    execute_write_reqs,
+    get_process_memory_budget_bytes,
+    sync_execute_write_reqs,
+)
+from tpusnap.storage_plugins.fs import FSStoragePlugin
+
+
+class TrackingStager(BufferStager):
+    """Stager that tracks global concurrent staging cost."""
+
+    live_cost = 0
+    peak_cost = 0
+
+    def __init__(self, data: bytes, cost: int):
+        self.data = data
+        self.cost = cost
+
+    async def stage_buffer(self, executor=None):
+        TrackingStager.live_cost += self.cost
+        TrackingStager.peak_cost = max(
+            TrackingStager.peak_cost, TrackingStager.live_cost
+        )
+        await asyncio.sleep(0.01)
+        # buffer stays "live" until the write completes; we approximate by
+        # decrementing at write time via WriteTracker below
+        return self.data
+
+    def get_staging_cost_bytes(self) -> int:
+        return self.cost
+
+
+class ByteConsumer(BufferConsumer):
+    def __init__(self, sink: dict, key: str, cost: int = 0):
+        self.sink = sink
+        self.key = key
+        self.cost = cost
+
+    async def consume_buffer(self, buf, executor=None) -> None:
+        self.sink[self.key] = bytes(buf)
+
+    def get_consuming_cost_bytes(self) -> int:
+        return self.cost
+
+
+class FaultyStager(BufferStager):
+    async def stage_buffer(self, executor=None):
+        raise RuntimeError("staging boom")
+
+    def get_staging_cost_bytes(self) -> int:
+        return 10
+
+
+class FaultyPlugin(FSStoragePlugin):
+    async def write(self, write_io) -> None:
+        raise OSError("storage boom")
+
+
+def test_write_then_read_roundtrip(tmp_path):
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    blobs = {f"blob{i}": os.urandom(1000 + i) for i in range(40)}
+    write_reqs = [
+        WriteReq(path=k, buffer_stager=TrackingStager(v, cost=len(v)))
+        for k, v in blobs.items()
+    ]
+    loop = asyncio.new_event_loop()
+    try:
+        pending = sync_execute_write_reqs(
+            write_reqs, plugin, memory_budget_bytes=1 << 30, rank=0, event_loop=loop
+        )
+        assert isinstance(pending, PendingIOWork)
+        pending.sync_complete(loop)
+
+        sink = {}
+        read_reqs = [
+            ReadReq(path=k, buffer_consumer=ByteConsumer(sink, k, cost=len(v)))
+            for k, v in blobs.items()
+        ]
+        loop.run_until_complete(
+            execute_read_reqs(read_reqs, plugin, 1 << 30, rank=0)
+        )
+        assert sink == blobs
+    finally:
+        loop.close()
+
+
+def test_budget_gates_staging(tmp_path):
+    """With a budget of 2 units and 8 one-unit items, peak concurrent
+    staging cost must never exceed the budget."""
+    TrackingStager.live_cost = 0
+    TrackingStager.peak_cost = 0
+    plugin = FSStoragePlugin(root=str(tmp_path))
+
+    unit = 1000
+    blobs = {f"b{i}": os.urandom(unit) for i in range(8)}
+
+    class DecrementingPlugin(FSStoragePlugin):
+        async def write(self, write_io) -> None:
+            await super().write(write_io)
+            TrackingStager.live_cost -= len(write_io.buf)
+
+    plugin = DecrementingPlugin(root=str(tmp_path))
+    write_reqs = [
+        WriteReq(path=k, buffer_stager=TrackingStager(v, cost=unit))
+        for k, v in blobs.items()
+    ]
+
+    async def go():
+        pending = await execute_write_reqs(
+            write_reqs, plugin, memory_budget_bytes=2 * unit, rank=0
+        )
+        await pending.complete()
+
+    asyncio.run(go())
+    assert TrackingStager.peak_cost <= 2 * unit
+
+
+def test_over_budget_item_still_runs(tmp_path):
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    data = os.urandom(5000)
+    write_reqs = [
+        WriteReq(path="huge", buffer_stager=TrackingStager(data, cost=len(data)))
+    ]
+
+    async def go():
+        pending = await execute_write_reqs(
+            write_reqs, plugin, memory_budget_bytes=10, rank=0
+        )
+        await pending.complete()
+
+    asyncio.run(go())  # must not deadlock
+    assert (tmp_path / "huge").read_bytes() == data
+
+
+def test_staging_failure_propagates(tmp_path):
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    write_reqs = [WriteReq(path="x", buffer_stager=FaultyStager())]
+
+    async def go():
+        pending = await execute_write_reqs(write_reqs, plugin, 1 << 30, rank=0)
+        await pending.complete()
+
+    with pytest.raises(RuntimeError, match="staging boom"):
+        asyncio.run(go())
+
+
+def test_storage_failure_propagates_on_complete(tmp_path):
+    plugin = FaultyPlugin(root=str(tmp_path))
+    write_reqs = [
+        WriteReq(path="x", buffer_stager=TrackingStager(b"abc", cost=3))
+    ]
+
+    async def go():
+        pending = await execute_write_reqs(write_reqs, plugin, 1 << 30, rank=0)
+        await pending.complete()
+
+    with pytest.raises(OSError, match="storage boom"):
+        asyncio.run(go())
+
+
+def test_memory_budget_env_override():
+    with override_memory_budget_bytes(12345):
+        assert get_process_memory_budget_bytes() == 12345
+    budget = get_process_memory_budget_bytes()
+    assert 0 < budget <= 32 * 1024**3
+
+
+def test_read_budget_gating(tmp_path):
+    """Reads with consuming cost above budget must still complete (one at a
+    time) and all data must arrive."""
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    blobs = {f"r{i}": os.urandom(500) for i in range(6)}
+    loop = asyncio.new_event_loop()
+    try:
+        for k, v in blobs.items():
+            from tpusnap.io_types import WriteIO
+
+            plugin.sync_write(WriteIO(path=k, buf=v), event_loop=loop)
+        sink = {}
+        read_reqs = [
+            ReadReq(path=k, buffer_consumer=ByteConsumer(sink, k, cost=400))
+            for k in blobs
+        ]
+        loop.run_until_complete(execute_read_reqs(read_reqs, plugin, 450, rank=0))
+        assert sink == blobs
+    finally:
+        loop.close()

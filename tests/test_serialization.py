@@ -1,0 +1,119 @@
+"""Per-dtype serialization round-trips, mirroring the reference's
+tests/test_serialization.py:32-101."""
+
+import numpy as np
+import pytest
+
+from tpusnap.serialization import (
+    SUPPORTED_DTYPES,
+    Serializer,
+    array_as_memoryview,
+    array_from_memoryview,
+    dtype_itemsize,
+    dtype_to_string,
+    pickle_as_bytes,
+    pickle_from_bytes,
+    string_to_dtype,
+    tensor_nbytes,
+)
+
+
+def rand_array(dtype_str: str, shape=(16, 9)) -> np.ndarray:
+    """Random array of any supported dtype with full bit diversity."""
+    rng = np.random.default_rng(42)
+    dtype = string_to_dtype(dtype_str)
+    raw = rng.integers(0, 256, size=(*shape, dtype.itemsize), dtype=np.uint8)
+    if dtype_str == "bool":
+        return (raw[..., 0] & 1).astype(bool)
+    if dtype_str.startswith("float") or dtype_str.startswith("bfloat"):
+        # keep finite values so equality checks aren't confounded by NaN
+        base = rng.standard_normal(shape).astype(np.float32)
+        return base.astype(dtype)
+    if dtype_str.startswith("complex"):
+        return (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)).astype(
+            dtype
+        )
+    return raw.view(dtype).reshape(*shape, -1)[..., 0].copy()
+
+
+@pytest.mark.parametrize("dtype_str", sorted(SUPPORTED_DTYPES))
+def test_buffer_roundtrip_bit_identical(dtype_str):
+    arr = rand_array(dtype_str)
+    mv = array_as_memoryview(arr)
+    assert mv.nbytes == arr.nbytes == tensor_nbytes(dtype_str, arr.shape)
+    restored = array_from_memoryview(mv, dtype_str, arr.shape)
+    assert restored.dtype == arr.dtype
+    assert restored.shape == arr.shape
+    # bit-identical comparison through raw bytes
+    assert bytes(mv) == restored.tobytes() == arr.tobytes()
+
+
+def test_zero_copy_no_conversion():
+    arr = np.arange(1024, dtype=np.float32)
+    mv = array_as_memoryview(arr)
+    # mutate source; the view must observe it (proof of zero-copy)
+    arr[0] = 123.0
+    assert np.frombuffer(mv, dtype=np.float32)[0] == 123.0
+
+
+def test_noncontiguous_copied():
+    arr = np.arange(100, dtype=np.int32).reshape(10, 10).T
+    mv = array_as_memoryview(arr)
+    restored = array_from_memoryview(mv, "int32", (10, 10))
+    np.testing.assert_array_equal(restored, np.ascontiguousarray(arr))
+
+
+def test_empty_array():
+    arr = np.zeros((0, 5), dtype=np.float32)
+    mv = array_as_memoryview(arr)
+    assert mv.nbytes == 0
+    restored = array_from_memoryview(mv, "float32", (0, 5))
+    assert restored.shape == (0, 5)
+
+
+def test_bf16_bit_exact():
+    import ml_dtypes
+
+    # every possible bf16 bit pattern incl. NaNs/infs round-trips exactly
+    bits = np.arange(65536, dtype=np.uint16)
+    arr = bits.view(ml_dtypes.bfloat16)
+    mv = array_as_memoryview(arr)
+    restored = array_from_memoryview(mv, "bfloat16", arr.shape)
+    assert restored.tobytes() == arr.tobytes()
+
+
+def test_dtype_string_tables():
+    import jax.numpy as jnp
+
+    for name in ["float32", "bfloat16", "int8", "bool", "complex64"]:
+        assert dtype_to_string(string_to_dtype(name)) == name
+        assert dtype_itemsize(name) == string_to_dtype(name).itemsize
+    # jax dtypes map through numpy
+    assert dtype_to_string(jnp.bfloat16) == "bfloat16"
+    assert dtype_to_string(jnp.float32) == "float32"
+    with pytest.raises(ValueError):
+        dtype_to_string(np.dtype("datetime64[s]"))
+    with pytest.raises(ValueError):
+        string_to_dtype("qint8")
+
+
+def test_pickle_fallback():
+    obj = {"a": [1, 2], "b": {3, 4}, "c": slice(1, 2)}
+    assert pickle_from_bytes(pickle_as_bytes(obj)) == obj
+    assert Serializer.PICKLE.value == "pickle"
+
+
+def test_memoryview_stream():
+    from tpusnap.memoryview_stream import MemoryviewStream
+
+    data = bytes(range(256))
+    s = MemoryviewStream(memoryview(data))
+    assert s.read(10) == data[:10]
+    assert s.tell() == 10
+    s.seek(-6, 2)
+    assert s.read() == data[-6:]
+    s.seek(0)
+    buf = bytearray(300)
+    assert s.readinto(buf) == 256
+    assert bytes(buf[:256]) == data
+    assert len(s) == 256

@@ -1,0 +1,191 @@
+"""Single-process end-to-end Snapshot.take/restore tests, mirroring the
+reference's tests/test_snapshot.py:21-169."""
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusnap import PytreeState, RNGState, Snapshot, StateDict
+from tpusnap.knobs import override_max_chunk_size_bytes
+from tpusnap.manifest import (
+    ChunkedTensorEntry,
+    ObjectEntry,
+    PrimitiveEntry,
+    TensorEntry,
+)
+
+
+def _tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x = np.asarray(x)
+        y = np.asarray(y)
+        assert x.dtype == y.dtype, (x.dtype, y.dtype)
+        assert x.tobytes() == y.tobytes()
+
+
+def test_take_restore_state_dict(tmp_path, toggle_batching):
+    app_state = {
+        "state": StateDict(
+            w=jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+            b=np.random.default_rng(0).standard_normal(8).astype(np.float32),
+            bf=jnp.ones((4, 4), dtype=jnp.bfloat16) * 1.5,
+            epoch=7,
+            lr=0.125,
+            name="run/1%x",
+            flag=True,
+            blob=b"\x00\x01",
+            nested={"a": [jnp.zeros(3), 2], "t": (jnp.ones(2), "s")},
+        )
+    }
+    saved_w = np.asarray(app_state["state"]["w"]).copy()
+    Snapshot.take(str(tmp_path / "snap"), app_state)
+
+    dst = {
+        "state": StateDict(
+            w=jnp.zeros((8, 8), dtype=jnp.float32),
+            b=np.zeros(8, dtype=np.float32),
+            bf=jnp.zeros((4, 4), dtype=jnp.bfloat16),
+            epoch=0,
+            lr=0.0,
+            name="",
+            flag=False,
+            blob=b"",
+            nested={"a": [jnp.ones(3), 0], "t": (jnp.zeros(2), "")},
+        )
+    }
+    Snapshot(str(tmp_path / "snap")).restore(dst)
+    s = dst["state"]
+    assert np.array_equal(np.asarray(s["w"]), saved_w)
+    assert s["epoch"] == 7
+    assert s["lr"] == 0.125
+    assert s["name"] == "run/1%x"
+    assert s["flag"] is True
+    assert s["blob"] == b"\x00\x01"
+    assert np.asarray(s["bf"]).tobytes() == np.asarray(app_state["state"]["bf"]).tobytes()
+    assert isinstance(s["nested"]["t"], tuple)
+    _tree_equal(s["nested"], app_state["state"]["nested"])
+
+
+def test_take_restore_pytree_trainstate(tmp_path):
+    """flax-style params + optax optimizer state round-trip."""
+    import optax
+
+    params = {
+        "dense": {"kernel": jnp.ones((16, 4)), "bias": jnp.zeros(4)},
+        "emb": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+    }
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    app_state = {"train": PytreeState({"params": params, "opt": opt_state})}
+    Snapshot.take(str(tmp_path / "snap"), app_state)
+
+    params2 = jax.tree.map(lambda x: jnp.zeros_like(x), params)
+    opt2 = tx.init(params2)
+    dst_state = PytreeState({"params": params2, "opt": opt2})
+    Snapshot(str(tmp_path / "snap")).restore({"train": dst_state})
+
+    _tree_equal(dst_state.tree["params"], params)
+    _tree_equal(dst_state.tree["opt"], opt_state)
+    # NamedTuple structure preserved
+    assert type(dst_state.tree["opt"]) is type(opt_state)
+
+
+def test_chunked_roundtrip(tmp_path):
+    with override_max_chunk_size_bytes(1024):
+        arr = jnp.arange(4096, dtype=jnp.float32).reshape(64, 64)
+        app_state = {"s": StateDict(big=arr)}
+        snap = Snapshot.take(str(tmp_path / "snap"), app_state)
+        entry = snap.get_manifest()["0/s/big"]
+        assert isinstance(entry, ChunkedTensorEntry)
+        assert len(entry.chunks) == 16
+
+        dst = {"s": StateDict(big=jnp.zeros((64, 64), dtype=jnp.float32))}
+        snap.restore(dst)
+        assert np.array_equal(np.asarray(dst["s"]["big"]), np.asarray(arr))
+
+
+def test_manifest_entry_types(tmp_path):
+    app_state = {
+        "s": StateDict(
+            t=jnp.ones(3), n=7, f=1.5, string="x", obj={1, 2, 3}
+        )
+    }
+    snap = Snapshot.take(str(tmp_path / "snap"), app_state)
+    manifest = snap.get_manifest()
+    assert isinstance(manifest["0/s/t"], TensorEntry)
+    assert isinstance(manifest["0/s/n"], PrimitiveEntry)
+    assert isinstance(manifest["0/s/f"], PrimitiveEntry)
+    assert isinstance(manifest["0/s/string"], PrimitiveEntry)
+    assert isinstance(manifest["0/s/obj"], ObjectEntry)
+    # primitives are inlined: restorable without touching their blobs
+    dst = {"s": StateDict(t=jnp.zeros(3), n=0, f=0.0, string="", obj=set())}
+    snap.restore(dst)
+    assert dst["s"]["n"] == 7 and dst["s"]["obj"] == {1, 2, 3}
+
+
+def test_structure_drift(tmp_path):
+    """Loading into a state dict with extra/missing keys (reference
+    tests/test_snapshot.py structure-drift case)."""
+    app_state = {"s": StateDict(a=1, b=2)}
+    snap = Snapshot.take(str(tmp_path / "snap"), app_state)
+    dst = {"s": StateDict(a=0, c=99)}
+    snap.restore(dst)
+    assert dst["s"]["a"] == 1
+    assert dst["s"]["b"] == 2  # appeared from snapshot
+    assert "c" not in dst["s"]  # dropped: not in snapshot
+
+
+def test_rng_state_invariance(tmp_path):
+    rng = RNGState()
+    app_state = {"rng": rng, "s": StateDict(x=1)}
+    np.random.seed(1234)
+    before = np.random.get_state()[1].copy()
+    snap = Snapshot.take(str(tmp_path / "snap"), app_state)
+    after = np.random.get_state()[1]
+    assert np.array_equal(before, after), "take() perturbed RNG state"
+
+    expected_draw = np.random.rand(4)  # the draw the restored RNG must repeat
+    np.random.seed(999)
+    snap.restore({"rng": RNGState(), "s": StateDict(x=0)})
+    assert np.allclose(np.random.rand(4), expected_draw)
+
+
+def test_restore_missing_snapshot_raises(tmp_path):
+    with pytest.raises(RuntimeError, match="not a snapshot"):
+        Snapshot(str(tmp_path / "nope")).restore({"s": StateDict()})
+
+
+def test_take_restore_all_dtypes(tmp_path):
+    from tpusnap.serialization import SUPPORTED_DTYPES, string_to_dtype
+
+    state = {}
+    for name in SUPPORTED_DTYPES:
+        if name.startswith("complex"):
+            arr = np.ones((3, 3), dtype=string_to_dtype(name)) * (1 + 2j)
+        else:
+            arr = np.ones((3, 3), dtype=string_to_dtype(name))
+        state[name] = jnp.asarray(arr) if not name.startswith("complex") else arr
+    app_state = {"d": StateDict(**state)}
+    snap = Snapshot.take(str(tmp_path / "snap"), app_state)
+    dst = {
+        "d": StateDict(
+            **{k: np.zeros((3, 3), dtype=np.asarray(v).dtype) for k, v in state.items()}
+        )
+    }
+    snap.restore(dst)
+    for name, orig in state.items():
+        assert np.asarray(dst["d"][name]).tobytes() == np.asarray(orig).tobytes(), name
+
+
+def test_metadata_file_is_last(tmp_path):
+    """The metadata file marks commit: its presence implies all data files
+    are complete."""
+    snap_path = tmp_path / "snap"
+    Snapshot.take(str(snap_path), {"s": StateDict(x=jnp.ones(4))})
+    assert (snap_path / ".snapshot_metadata").exists()

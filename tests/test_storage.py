@@ -1,0 +1,163 @@
+"""fs plugin + registry + native helper tests (reference exercises its fs
+plugin implicitly via Snapshot tests and tmp_path)."""
+
+import asyncio
+import os
+
+import pytest
+
+from tpusnap.io_types import ReadIO, WriteIO
+from tpusnap.storage_plugin import url_to_storage_plugin
+from tpusnap.storage_plugins.fs import FSStoragePlugin
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_registry_schemes(tmp_path):
+    p = url_to_storage_plugin(str(tmp_path))
+    assert isinstance(p, FSStoragePlugin)
+    p = url_to_storage_plugin(f"fs://{tmp_path}")
+    assert isinstance(p, FSStoragePlugin)
+    p = url_to_storage_plugin(f"fsspec+memory://snap")
+    from tpusnap.storage_plugins.fsspec import FsspecStoragePlugin
+
+    assert isinstance(p, FsspecStoragePlugin)
+    with pytest.raises(RuntimeError, match="Unsupported storage scheme"):
+        url_to_storage_plugin("bogus://x")
+    with pytest.raises(RuntimeError, match="aiobotocore"):
+        url_to_storage_plugin("s3://bucket/prefix")
+
+
+def test_fs_write_read_roundtrip(tmp_path):
+    plugin = FSStoragePlugin(root=str(tmp_path))
+
+    async def go():
+        data = os.urandom(1 << 16)
+        await plugin.write(WriteIO(path="a/b/c", buf=memoryview(data)))
+        read_io = ReadIO(path="a/b/c")
+        await plugin.read(read_io)
+        assert read_io.buf.getvalue() == data
+        # ranged read
+        read_io = ReadIO(path="a/b/c", byte_range=(100, 356))
+        await plugin.read(read_io)
+        assert read_io.buf.getvalue() == data[100:356]
+        await plugin.delete("a/b/c")
+        assert not (tmp_path / "a" / "b" / "c").exists()
+        await plugin.close()
+
+    _run(go())
+
+
+def test_fs_large_write_native_path(tmp_path):
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    data = os.urandom(5 * 1024 * 1024)  # over the native threshold
+
+    async def go():
+        await plugin.write(WriteIO(path="big", buf=memoryview(data)))
+        read_io = ReadIO(path="big")
+        await plugin.read(read_io)
+        assert read_io.buf.getvalue() == data
+        await plugin.close()
+
+    _run(go())
+
+
+def test_fs_concurrent_writes(tmp_path):
+    plugin = FSStoragePlugin(root=str(tmp_path))
+
+    async def go():
+        blobs = {f"obj{i}": os.urandom(10_000) for i in range(32)}
+        await asyncio.gather(
+            *(plugin.write(WriteIO(path=k, buf=v)) for k, v in blobs.items())
+        )
+        for k, v in blobs.items():
+            read_io = ReadIO(path=k)
+            await plugin.read(read_io)
+            assert read_io.buf.getvalue() == v
+        await plugin.close()
+
+    _run(go())
+
+
+def test_fsspec_memory_roundtrip():
+    plugin = url_to_storage_plugin("fsspec+memory://snaptest")
+
+    async def go():
+        await plugin.write(WriteIO(path="x/y", buf=b"hello"))
+        read_io = ReadIO(path="x/y")
+        await plugin.read(read_io)
+        assert read_io.buf.getvalue() == b"hello"
+        read_io = ReadIO(path="x/y", byte_range=(1, 4))
+        await plugin.read(read_io)
+        assert read_io.buf.getvalue() == b"ell"
+        await plugin.delete("x/y")
+        await plugin.close()
+
+    _run(go())
+
+
+def test_sync_shims(tmp_path):
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    plugin.sync_write(WriteIO(path="s", buf=b"sync"))
+    read_io = ReadIO(path="s")
+    plugin.sync_read(read_io)
+    assert read_io.buf.getvalue() == b"sync"
+    plugin.sync_close()
+
+
+class TestNative:
+    def test_write_and_read_range(self, tmp_path):
+        from tpusnap import _native
+
+        data = os.urandom(1 << 20)
+        path = str(tmp_path / "n.bin")
+        _native.write_file(path, memoryview(data))
+        assert open(path, "rb").read() == data
+        out = bytearray(1000)
+        got = _native.read_range(path, 500, 1000, out)
+        assert got == 1000 and bytes(out) == data[500:1500]
+        # EOF-short read
+        out = bytearray(100)
+        got = _native.read_range(path, len(data) - 10, 100, out)
+        assert got == 10 and bytes(out[:10]) == data[-10:]
+
+    def test_memcpy(self):
+        from tpusnap import _native
+
+        src = os.urandom(3 << 20)
+        dst = bytearray(len(src))
+        _native.memcpy(dst, src)
+        assert bytes(dst) == src
+        with pytest.raises(ValueError):
+            _native.memcpy(bytearray(5), b"123")
+
+    def test_crc32c_known_vector(self):
+        from tpusnap import _native
+
+        if not _native.available():
+            pytest.skip("native unavailable")
+        # RFC 3720 test vector: crc32c of 32 zero bytes == 0x8a9136aa
+        assert _native.crc32c(bytes(32)) == 0x8A9136AA
+        assert _native.checksum_algorithm() == "crc32c"
+
+    def test_disabled_fallback(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUSNAP_DISABLE_NATIVE", "1")
+        # force a fresh load decision in a subprocess to honor the env var
+        import subprocess
+        import sys
+
+        code = (
+            "import os; os.environ['TPUSNAP_DISABLE_NATIVE']='1';"
+            "from tpusnap import _native;"
+            f"p=r'{tmp_path}/f.bin';"
+            "_native.write_file(p, b'abc');"
+            "assert open(p,'rb').read()==b'abc';"
+            "assert not _native.available();"
+            "print('fallback-ok')"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, cwd="/root/repo"
+        )
+        assert "fallback-ok" in out.stdout, out.stderr

@@ -30,5 +30,5 @@ try:  # pragma: no cover - import surface grows as modules land
 except ModuleNotFoundError as e:  # modules not created yet during bootstrap
     # Only swallow "tpusnap.X does not exist yet"; a failure inside an
     # existing submodule (or a missing third-party dep) must propagate.
-    if not (e.name or "").startswith("tpusnap"):
+    if not (e.name == "tpusnap" or (e.name or "").startswith("tpusnap.")):
         raise

@@ -1,0 +1,196 @@
+"""ctypes bindings for tpusnap's native C++ helpers, compiled on demand.
+
+The .so is built from src/tpusnap_native.cpp with g++ the first time it is
+needed (or when the source is newer than the binary). Every entry point has
+a pure-Python fallback, and ``TPUSNAP_DISABLE_NATIVE=1`` forces the
+fallbacks — so the library works (slower) without a toolchain.
+
+ctypes releases the GIL around foreign calls, which is the whole point:
+file writes, ranged reads, and large memcpys run concurrently with Python
+threads, the role torch's native ops play in the reference
+(/root/reference/torchsnapshot/io_preparers/tensor.py:351-358).
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "tpusnap_native.cpp")
+_SO = os.path.join(_DIR, "libtpusnap_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+_lock = threading.Lock()
+
+
+def _build() -> bool:
+    cmd = [
+        "g++",
+        "-O3",
+        "-march=native",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        "-std=c++17",
+        "-o",
+        _SO,
+        _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # toolchain missing/failed: fall back to Python
+        logger.warning("tpusnap native build failed (%s); using Python fallbacks", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        from ..knobs import is_native_disabled
+
+        if is_native_disabled():
+            return None
+        stale = not os.path.exists(_SO) or (
+            os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        )
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            logger.warning("tpusnap native load failed (%s)", e)
+            return None
+        lib.ts_write_file.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+        ]
+        lib.ts_write_file.restype = ctypes.c_int
+        lib.ts_read_range.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_size_t,
+        ]
+        lib.ts_read_range.restype = ctypes.c_int64
+        lib.ts_memcpy_par.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+        ]
+        lib.ts_memcpy_par.restype = None
+        lib.ts_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32]
+        lib.ts_crc32c.restype = ctypes.c_uint32
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(buf) -> Tuple[int, np.ndarray]:
+    """Raw data pointer of any buffer (incl. read-only), zero-copy.
+
+    Returns (address, keepalive) — the caller must hold ``keepalive`` for
+    the duration of the foreign call.
+    """
+    arr = np.frombuffer(memoryview(buf).cast("B"), dtype=np.uint8)
+    return arr.ctypes.data, arr
+
+
+def write_file(path: str, buf) -> None:
+    """Whole-buffer file write with the GIL released for the full transfer."""
+    mv = memoryview(buf).cast("B")
+    lib = _load()
+    if lib is None:
+        with open(path, "wb", buffering=0) as f:
+            f.write(mv)
+        return
+    if mv.nbytes == 0:
+        open(path, "wb").close()
+        return
+    ptr, keepalive = _ptr(mv)
+    rc = lib.ts_write_file(path.encode(), ptr, mv.nbytes)
+    del keepalive
+    if rc != 0:
+        raise OSError(-rc, os.strerror(-rc), path)
+
+
+def read_range(path: str, offset: int, n: int, out) -> int:
+    """Positional ranged read into ``out`` (writable buffer); returns bytes
+    read (short only at EOF)."""
+    mv = memoryview(out).cast("B")
+    if mv.readonly:
+        raise ValueError("out buffer must be writable")
+    if n > mv.nbytes:
+        raise ValueError(f"out buffer too small: {mv.nbytes} < {n}")
+    lib = _load()
+    if lib is None:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(n)
+        mv[: len(data)] = data
+        return len(data)
+    if n == 0:
+        return 0
+    ptr, keepalive = _ptr(mv)
+    got = lib.ts_read_range(path.encode(), ptr, offset, n)
+    del keepalive
+    if got < 0:
+        raise OSError(-got, os.strerror(-got), path)
+    return got
+
+
+def memcpy(dst, src, nthreads: int = 4) -> None:
+    """GIL-released (and multi-threaded for large buffers) memcpy."""
+    dst_mv = memoryview(dst).cast("B")
+    src_mv = memoryview(src).cast("B")
+    if dst_mv.readonly:
+        raise ValueError("dst must be writable")
+    if dst_mv.nbytes != src_mv.nbytes:
+        raise ValueError(f"size mismatch: {dst_mv.nbytes} != {src_mv.nbytes}")
+    lib = _load()
+    if lib is None or dst_mv.nbytes < (1 << 20):
+        dst_mv[:] = src_mv
+        return
+    dst_ptr, dst_keep = _ptr(dst_mv)
+    src_ptr, src_keep = _ptr(src_mv)
+    lib.ts_memcpy_par(dst_ptr, src_ptr, dst_mv.nbytes, nthreads)
+    del dst_keep, src_keep
+
+
+def crc32c(buf, seed: int = 0) -> int:
+    """CRC32C (Castagnoli) of a buffer. The pure-Python fallback uses
+    zlib.crc32 — a different polynomial — so checksums must only ever be
+    compared when produced by the same implementation; callers record the
+    algorithm alongside the value."""
+    mv = memoryview(buf).cast("B")
+    lib = _load()
+    if lib is None:
+        import zlib
+
+        return zlib.crc32(mv, seed)
+    if mv.nbytes == 0:
+        return lib.ts_crc32c(None, 0, seed)
+    ptr, keepalive = _ptr(mv)
+    out = lib.ts_crc32c(ptr, mv.nbytes, seed)
+    del keepalive
+    return out
+
+
+def checksum_algorithm() -> str:
+    return "crc32c" if available() else "zlib-crc32"

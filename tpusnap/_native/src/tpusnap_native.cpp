@@ -1,0 +1,130 @@
+// Native helpers for tpusnap's hot I/O paths.
+//
+// The reference gets GIL-released native copies/writes for free through
+// torch (TorchScripted tensor copies, torch's file I/O —
+// /root/reference/torchsnapshot/io_preparers/tensor.py:351-358). JAX has no
+// such runtime, so this tiny C++ library supplies the equivalents:
+//
+//   ts_write_file    — whole-buffer file write (single open/write loop, no
+//                      Python-level chunking, GIL released by the caller)
+//   ts_read_range    — positional ranged read into a caller buffer
+//   ts_memcpy_par    — multi-threaded memcpy for staging large host buffers
+//   ts_crc32c        — CRC32C (Castagnoli, software slice-by-8) for
+//                      optional integrity checksums
+//
+// Built on demand by tpusnap/_native/__init__.py with:
+//   g++ -O3 -shared -fPIC -pthread -o libtpusnap_native.so tpusnap_native.cpp
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+
+// Returns 0 on success, -errno on failure.
+int ts_write_file(const char* path, const void* buf, size_t n) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -errno;
+  const char* p = static_cast<const char*>(buf);
+  size_t remaining = n;
+  while (remaining > 0) {
+    ssize_t written = ::write(fd, p, remaining);
+    if (written < 0) {
+      if (errno == EINTR) continue;
+      int err = errno;
+      ::close(fd);
+      return -err;
+    }
+    p += written;
+    remaining -= static_cast<size_t>(written);
+  }
+  if (::close(fd) < 0) return -errno;
+  return 0;
+}
+
+// Positional ranged read. Returns bytes read (>=0) or -errno.
+int64_t ts_read_range(const char* path, void* out, int64_t offset, size_t n) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  char* p = static_cast<char*>(out);
+  size_t remaining = n;
+  int64_t pos = offset;
+  while (remaining > 0) {
+    ssize_t got = ::pread(fd, p, remaining, pos);
+    if (got < 0) {
+      if (errno == EINTR) continue;
+      int err = errno;
+      ::close(fd);
+      return -err;
+    }
+    if (got == 0) break;  // EOF
+    p += got;
+    pos += got;
+    remaining -= static_cast<size_t>(got);
+  }
+  ::close(fd);
+  return static_cast<int64_t>(n - remaining);
+}
+
+// Multi-threaded memcpy; nthreads <= 1 degrades to plain memcpy.
+void ts_memcpy_par(void* dst, const void* src, size_t n, int nthreads) {
+  if (nthreads <= 1 || n < (8u << 20)) {
+    std::memcpy(dst, src, n);
+    return;
+  }
+  size_t chunk = (n + nthreads - 1) / nthreads;
+  std::vector<std::thread> threads;
+  threads.reserve(nthreads);
+  for (int i = 0; i < nthreads; ++i) {
+    size_t off = static_cast<size_t>(i) * chunk;
+    if (off >= n) break;
+    size_t len = (off + chunk <= n) ? chunk : (n - off);
+    threads.emplace_back([=] {
+      std::memcpy(static_cast<char*>(dst) + off,
+                  static_cast<const char*>(src) + off, len);
+    });
+  }
+  for (auto& t : threads) t.join();
+}
+
+static uint32_t kCrcTable[8][256];
+static bool kCrcInit = [] {
+  const uint32_t poly = 0x82f63b78u;  // CRC32C (Castagnoli), reflected
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; ++j) crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    kCrcTable[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; ++i)
+    for (int s = 1; s < 8; ++s)
+      kCrcTable[s][i] =
+          (kCrcTable[s - 1][i] >> 8) ^ kCrcTable[0][kCrcTable[s - 1][i] & 0xff];
+  return true;
+}();
+
+uint32_t ts_crc32c(const void* buf, size_t n, uint32_t seed) {
+  (void)kCrcInit;
+  uint32_t crc = ~seed;
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n >= 8) {
+    crc ^= static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+           (static_cast<uint32_t>(p[2]) << 16) |
+           (static_cast<uint32_t>(p[3]) << 24);
+    crc = kCrcTable[7][crc & 0xff] ^ kCrcTable[6][(crc >> 8) & 0xff] ^
+          kCrcTable[5][(crc >> 16) & 0xff] ^ kCrcTable[4][crc >> 24] ^
+          kCrcTable[3][p[4]] ^ kCrcTable[2][p[5]] ^ kCrcTable[1][p[6]] ^
+          kCrcTable[0][p[7]];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = (crc >> 8) ^ kCrcTable[0][(crc ^ *p++) & 0xff];
+  return ~crc;
+}
+
+}  // extern "C"

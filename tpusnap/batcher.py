@@ -1,0 +1,203 @@
+"""Request batcher: coalesce small writes into slabs; merge adjacent
+byte-ranged reads into spanning reads.
+
+Counterpart of /root/reference/torchsnapshot/batcher.py:48-474. Small
+(< slab threshold) buffer-protocol array writes are packed into
+uuid-named slab objects under ``batched/``; each member's TensorEntry is
+rewritten in place to point at ``(slab_location, byte_range)``. Cloud
+object stores charge per request and throttle request rates, so slab
+packing is what makes thousands-of-small-parameters models fast on
+S3/GCS. On read, byte-ranged requests against the same location are
+merged into one spanning read and sliced back out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from concurrent.futures import Executor
+from typing import Dict, List, Optional, Tuple
+
+from .io_types import (
+    BufferConsumer,
+    BufferStager,
+    BufferType,
+    ReadReq,
+    WriteReq,
+)
+from .io_preparers.array import ArrayBufferStager
+from .knobs import get_slab_size_threshold_bytes, is_batching_disabled
+from .manifest import ChunkedTensorEntry, Entry, TensorEntry
+
+
+def _batchable_tensor_entries(entries: List[Entry]) -> Dict[str, TensorEntry]:
+    """location → TensorEntry for every dense tensor blob (incl. chunks)."""
+    out: Dict[str, TensorEntry] = {}
+    for entry in entries:
+        if isinstance(entry, TensorEntry):
+            out[entry.location] = entry
+        elif isinstance(entry, ChunkedTensorEntry):
+            for chunk in entry.chunks:
+                out[chunk.tensor.location] = chunk.tensor
+    return out
+
+
+class BatchedBufferStager(BufferStager):
+    """Stages all members concurrently into one contiguous bytearray
+    (reference BatchedBufferStager, batcher.py:48-98)."""
+
+    def __init__(self, members: List[Tuple[int, int, BufferStager]]) -> None:
+        # members: [(offset, nbytes, stager)]
+        self.members = members
+        self.total = sum(n for _, n, _ in members)
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        slab = bytearray(self.total)
+
+        async def fill(offset: int, nbytes: int, stager: BufferStager) -> None:
+            buf = await stager.stage_buffer(executor)
+            mv = memoryview(buf).cast("B")
+            if mv.nbytes != nbytes:
+                raise RuntimeError(
+                    f"Batched member staged {mv.nbytes} bytes, expected {nbytes}"
+                )
+            slab[offset : offset + nbytes] = mv
+
+        await asyncio.gather(*(fill(o, n, s) for o, n, s in self.members))
+        return slab
+
+    def get_staging_cost_bytes(self) -> int:
+        # The slab plus transiently one member's own staging cost; the
+        # members' buffers are views/DMA targets released as they land.
+        return self.total + max((s.get_staging_cost_bytes() for _, _, s in self.members), default=0)
+
+
+def batch_write_requests(
+    entries: List[Entry], write_reqs: List[WriteReq]
+) -> Tuple[List[Entry], List[WriteReq]]:
+    """Pack small array writes into slabs, rewriting entries in place
+    (reference batch_write_requests, batcher.py:201-352)."""
+    threshold = get_slab_size_threshold_bytes()
+    if is_batching_disabled():
+        return entries, write_reqs
+
+    entry_by_location = _batchable_tensor_entries(entries)
+    candidates: List[WriteReq] = []
+    passthrough: List[WriteReq] = []
+    for wr in write_reqs:
+        stager = wr.buffer_stager
+        if (
+            isinstance(stager, ArrayBufferStager)
+            and wr.path in entry_by_location
+            and stager.get_staging_cost_bytes() < threshold
+        ):
+            candidates.append(wr)
+        else:
+            passthrough.append(wr)
+    if len(candidates) < 2:
+        return entries, write_reqs
+
+    batched_reqs: List[WriteReq] = []
+    slab_members: List[Tuple[int, int, BufferStager]] = []
+    slab_entries: List[TensorEntry] = []
+    offset = 0
+
+    def flush() -> None:
+        nonlocal offset, slab_members, slab_entries
+        if not slab_members:
+            return
+        if len(slab_members) == 1:
+            # A slab of one is pointless; leave the request as-is.
+            passthrough.append(
+                WriteReq(path=slab_entries[0].location, buffer_stager=slab_members[0][2])
+            )
+        else:
+            location = f"batched/{uuid.uuid4().hex}"
+            for (member_offset, nbytes, _), tensor_entry in zip(
+                slab_members, slab_entries
+            ):
+                tensor_entry.location = location
+                tensor_entry.byte_range = [member_offset, member_offset + nbytes]
+            batched_reqs.append(
+                WriteReq(
+                    path=location,
+                    buffer_stager=BatchedBufferStager(list(slab_members)),
+                )
+            )
+        offset = 0
+        slab_members = []
+        slab_entries = []
+
+    from .serialization import tensor_nbytes
+
+    for wr in candidates:
+        tensor_entry = entry_by_location[wr.path]
+        nbytes = tensor_nbytes(tensor_entry.dtype, tensor_entry.shape)
+        if offset + nbytes > threshold and slab_members:
+            flush()
+        slab_members.append((offset, nbytes, wr.buffer_stager))
+        slab_entries.append(tensor_entry)
+        offset += nbytes
+    flush()
+
+    return entries, passthrough + batched_reqs
+
+
+class _SpanningConsumer(BufferConsumer):
+    """Feeds slices of one spanning read to the member consumers
+    (reference read-side merge, batcher.py:384-474)."""
+
+    def __init__(
+        self, span_start: int, members: List[Tuple[Tuple[int, int], BufferConsumer]]
+    ) -> None:
+        self.span_start = span_start
+        self.members = members
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        mv = memoryview(buf).cast("B")
+        for (start, end), consumer in self.members:
+            await consumer.consume_buffer(
+                mv[start - self.span_start : end - self.span_start], executor
+            )
+
+    def get_consuming_cost_bytes(self) -> int:
+        return sum(c.get_consuming_cost_bytes() for _, c in self.members)
+
+
+def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
+    """Merge byte-ranged reads per location into one spanning read when the
+    span is dense enough that one request beats many."""
+    by_location: Dict[str, List[ReadReq]] = {}
+    passthrough: List[ReadReq] = []
+    for rr in read_reqs:
+        if rr.byte_range is not None:
+            by_location.setdefault(rr.path, []).append(rr)
+        else:
+            passthrough.append(rr)
+
+    out = list(passthrough)
+    for location, reqs in by_location.items():
+        if len(reqs) == 1:
+            out.extend(reqs)
+            continue
+        reqs.sort(key=lambda r: r.byte_range[0])
+        span_start = reqs[0].byte_range[0]
+        span_end = max(r.byte_range[1] for r in reqs)
+        total = sum(r.byte_range[1] - r.byte_range[0] for r in reqs)
+        if total < (span_end - span_start) * 0.5:
+            # Sparse: spanning read would over-fetch badly; keep individual.
+            out.extend(reqs)
+            continue
+        out.append(
+            ReadReq(
+                path=location,
+                byte_range=(span_start, span_end),
+                buffer_consumer=_SpanningConsumer(
+                    span_start,
+                    [(tuple(r.byte_range), r.buffer_consumer) for r in reqs],
+                ),
+            )
+        )
+    return out

@@ -1,0 +1,161 @@
+"""Metadata collectives over the JAX distributed coordination service.
+
+TPU-native counterpart of /root/reference/torchsnapshot/pg_wrapper.py.
+The reference funnels small-object collectives (all_gather_object,
+broadcast_object_list, barrier) through torch.distributed (gloo/NCCL).
+tpusnap instead rides the **coordination-service KV store** that
+``jax.distributed.initialize`` brings up over DCN:
+
+- it exists on every multi-host TPU deployment (no extra rendezvous);
+- it is usable from background threads, where device collectives are
+  forbidden (same constraint as the reference, snapshot.py:902);
+- manifests/globs/write-loads are KB-scale — device collectives over ICI
+  would be overkill (SURVEY.md §5).
+
+Like the reference's PGWrapper (pg_wrapper.py:15-30), construction
+auto-detects the environment: single process → no-op collectives;
+``jax.process_count() > 1`` → KV-store-backed collectives.
+
+Sequencing: every collective bumps a process-global sequence number.
+Ranks execute the same collectives in the same order (SPMD), so the
+sequence numbers agree across ranks and key collisions are impossible;
+keys are deleted after a trailing barrier.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import pickle
+from typing import Any, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_TIMEOUT_MS = 600_000  # mirrors reference dist_store.py:17 (600s)
+
+
+class Communicator:
+    """Uniform interface; base class doubles as the single-process no-op
+    implementation (reference pg_wrapper.py single-process path)."""
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def world_size(self) -> int:
+        return 1
+
+    def barrier(self) -> None:
+        return None
+
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        return [obj]
+
+    def broadcast_object(self, obj: Any, src: int = 0) -> Any:
+        return obj
+
+
+_seq = 0
+
+
+def _next_seq() -> int:
+    global _seq
+    _seq += 1
+    return _seq
+
+
+class JaxCoordinationComm(Communicator):
+    """KV-store-backed collectives for multi-process jobs."""
+
+    def __init__(self, timeout_ms: int = _DEFAULT_TIMEOUT_MS) -> None:
+        import jax
+
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "jax.distributed is not initialized; call "
+                "jax.distributed.initialize() before using tpusnap across "
+                "processes"
+            )
+        self._client = client
+        self._rank = jax.process_index()
+        self._world_size = jax.process_count()
+        self._timeout_ms = timeout_ms
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    def barrier(self) -> None:
+        seq = _next_seq()
+        self._client.wait_at_barrier(f"tpusnap_b{seq}", timeout_in_ms=self._timeout_ms)
+
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        seq = _next_seq()
+        prefix = f"tpusnap/ag{seq}"
+        self._client.key_value_set(f"{prefix}/{self._rank}", _encode(obj))
+        out = []
+        for r in range(self._world_size):
+            raw = self._client.blocking_key_value_get(
+                f"{prefix}/{r}", self._timeout_ms
+            )
+            out.append(_decode(raw))
+        # Everyone has read every key; rank 0 garbage-collects the prefix.
+        self.barrier()
+        if self._rank == 0:
+            try:
+                self._client.key_value_delete(prefix + "/")
+            except Exception:
+                pass
+        return out
+
+    def broadcast_object(self, obj: Any, src: int = 0) -> Any:
+        seq = _next_seq()
+        key = f"tpusnap/bc{seq}"
+        if self._rank == src:
+            self._client.key_value_set(key, _encode(obj))
+            result = obj
+        else:
+            result = _decode(
+                self._client.blocking_key_value_get(key, self._timeout_ms)
+            )
+        self.barrier()
+        if self._rank == src:
+            try:
+                self._client.key_value_delete(key)
+            except Exception:
+                pass
+        return result
+
+
+def _encode(obj: Any) -> str:
+    return base64.b64encode(pickle.dumps(obj)).decode("ascii")
+
+
+def _decode(raw) -> Any:
+    if isinstance(raw, bytes):
+        raw = raw.decode("ascii")
+    return pickle.loads(base64.b64decode(raw))
+
+
+def get_communicator(comm: Optional[Communicator] = None) -> Communicator:
+    """Auto-detect (reference pg_wrapper.py:15-30): explicit comm wins; a
+    live multi-process jax.distributed runtime selects the KV-backed
+    implementation; otherwise single-process no-op."""
+    if comm is not None:
+        return comm
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return JaxCoordinationComm()
+    except Exception:
+        pass
+    return Communicator()

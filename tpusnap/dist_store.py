@@ -1,0 +1,196 @@
+"""KV stores + LinearBarrier for thread-safe coordination.
+
+Counterpart of /root/reference/torchsnapshot/dist_store.py. The async
+snapshot commit runs on a background thread where collectives are
+forbidden (reference snapshot.py:902), so it synchronizes through a KV
+store instead:
+
+- ``CoordinationKVStore`` — the jax.distributed coordination-service
+  client (the TPU-native replacement for c10d TCPStore).
+- ``FileKVStore`` — a shared-filesystem store for single-host
+  multi-process tests (and a fallback when no coordination service is
+  up but ranks share a filesystem).
+- ``LinearBarrier`` — the reference's two-phase (arrive/depart) barrier
+  with error propagation (dist_store.py:91-196): any rank can
+  ``report_error``; every waiter then re-raises it, which is how a
+  failed async snapshot aborts the metadata commit on all ranks.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import pickle
+import tempfile
+import time
+from typing import Optional
+
+_DEFAULT_TIMEOUT_SEC = 600.0
+_POLL_INTERVAL_SEC = 0.05
+
+
+class KVStore(abc.ABC):
+    @abc.abstractmethod
+    def set(self, key: str, value: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def try_get(self, key: str) -> Optional[bytes]: ...
+
+    def get(self, key: str, timeout_sec: float = _DEFAULT_TIMEOUT_SEC) -> bytes:
+        deadline = time.monotonic() + timeout_sec
+        while True:
+            value = self.try_get(key)
+            if value is not None:
+                return value
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"Timed out waiting for key {key!r}")
+            time.sleep(_POLL_INTERVAL_SEC)
+
+
+class CoordinationKVStore(KVStore):
+    """Backed by the jax.distributed coordination service client."""
+
+    def __init__(self, prefix: str = "tpusnap_store") -> None:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError("jax.distributed is not initialized")
+        self._client = client
+        self._prefix = prefix
+
+    def _k(self, key: str) -> str:
+        return f"{self._prefix}/{key}"
+
+    def set(self, key: str, value: bytes) -> None:
+        import base64
+
+        self._client.key_value_set(self._k(key), base64.b64encode(value).decode())
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        import base64
+
+        try:
+            raw = self._client.key_value_try_get(self._k(key))
+        except Exception:
+            return None
+        if raw is None:
+            return None
+        if isinstance(raw, bytes):
+            raw = raw.decode()
+        return base64.b64decode(raw)
+
+
+class FileKVStore(KVStore):
+    """Directory-backed store; atomic via rename. Works wherever ranks
+    share a filesystem (incl. the snapshot destination itself)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.replace("/", "%2F"))
+
+    def set(self, key: str, value: bytes) -> None:
+        path = self._path(key)
+        fd, tmp = tempfile.mkstemp(dir=self.root)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(value)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+
+class MemoryKVStore(KVStore):
+    """In-process store for single-process operation and unit tests."""
+
+    def __init__(self) -> None:
+        self._data = {}
+
+    def set(self, key: str, value: bytes) -> None:
+        self._data[key] = value
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        return self._data.get(key)
+
+
+class LinearBarrierError(RuntimeError):
+    pass
+
+
+class LinearBarrier:
+    """Two-phase barrier with error propagation (reference
+    dist_store.py:91-196). Leader waits for every rank to arrive, then
+    signals departure. ``report_error`` poisons the barrier: all waiters
+    raise. Pure KV traffic — safe from non-main threads."""
+
+    def __init__(
+        self,
+        store: KVStore,
+        prefix: str,
+        rank: int,
+        world_size: int,
+        leader_rank: int = 0,
+        timeout_sec: float = _DEFAULT_TIMEOUT_SEC,
+    ) -> None:
+        self.store = store
+        self.prefix = prefix
+        self.rank = rank
+        self.world_size = world_size
+        self.leader_rank = leader_rank
+        self.timeout_sec = timeout_sec
+
+    def _key(self, *parts: str) -> str:
+        return "/".join((self.prefix,) + parts)
+
+    def _checked_get(self, key: str) -> bytes:
+        """Wait for a key while also watching for reported errors."""
+        deadline = time.monotonic() + self.timeout_sec
+        while True:
+            value = self.store.try_get(key)
+            if value is not None:
+                return value
+            for r in range(self.world_size):
+                err = self.store.try_get(self._key("error", str(r)))
+                if err is not None:
+                    raise LinearBarrierError(
+                        f"Rank {r} reported error: {pickle.loads(err)}"
+                    )
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"LinearBarrier {self.prefix!r}: timed out waiting for "
+                    f"{key!r}"
+                )
+            time.sleep(_POLL_INTERVAL_SEC)
+
+    def arrive(self) -> None:
+        self.store.set(self._key("arrive", str(self.rank)), b"1")
+        if self.rank == self.leader_rank:
+            for r in range(self.world_size):
+                self._checked_get(self._key("arrive", str(r)))
+
+    def depart(self) -> None:
+        if self.rank == self.leader_rank:
+            self.store.set(self._key("depart"), b"1")
+        else:
+            self._checked_get(self._key("depart"))
+
+    def report_error(self, exc: BaseException) -> None:
+        try:
+            payload = pickle.dumps(exc)
+        except Exception:
+            payload = pickle.dumps(RuntimeError(repr(exc)))
+        self.store.set(self._key("error", str(self.rank)), payload)

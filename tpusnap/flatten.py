@@ -145,11 +145,15 @@ def inflate(manifest: Manifest, flattened: Flattened, prefix: str = "") -> Any:
         for k in sorted(
             kids, key=lambda k: order.get(_decode(components[k]), len(order))
         ):
+            decoded = _decode(components[k])
+            if decoded not in key_by_str:
+                # The container entry is the source of truth for membership
+                # (reference flatten.py:176-199); stray leaves are dropped.
+                continue
             value = build(k)
             if value is _MISSING:
                 continue
-            decoded = _decode(components[k])
-            out[key_by_str.get(decoded, decoded)] = value
+            out[key_by_str[decoded]] = value
         return out
 
     return build("")

@@ -1,0 +1,101 @@
+"""Type → preparer dispatch for write and Entry → preparer dispatch for read.
+
+Counterpart of /root/reference/torchsnapshot/io_preparer.py:46-165.
+Routing (write):
+
+- int/float/bool/str/bytes         → inlined PrimitiveEntry (no I/O)
+- sharded jax.Array                → ShardedArrayIOPreparer ("sharded/...")
+- dense array above max_chunk_size → ChunkedArrayIOPreparer
+- dense array, supported dtype     → ArrayIOPreparer
+- anything else                    → ObjectIOPreparer (pickle)
+
+Storage paths: sharded entries under ``sharded/``, replicated entries
+under ``replicated/``, everything else under ``<rank>/``
+(reference io_preparer.py:46-52).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .io_types import Future, ReadReq, WriteReq
+from .manifest import (
+    ChunkedTensorEntry,
+    Entry,
+    ObjectEntry,
+    PrimitiveEntry,
+    ShardedEntry,
+    TensorEntry,
+)
+from .io_preparers.array import ArrayIOPreparer, is_supported_array_dtype
+from .io_preparers.chunked import ChunkedArrayIOPreparer, should_chunk
+from .io_preparers.object import ObjectIOPreparer
+from .io_preparers.sharded import ShardedArrayIOPreparer, is_sharded
+
+
+def get_storage_path(
+    logical_path: str, rank: int, replicated: bool, sharded: bool
+) -> str:
+    if sharded:
+        return f"sharded/{logical_path}"
+    if replicated:
+        return f"replicated/{logical_path}"
+    return f"{rank}/{logical_path}"
+
+
+def prepare_write(
+    obj: Any,
+    logical_path: str,
+    rank: int,
+    replicated: bool = False,
+    is_async_snapshot: bool = False,
+) -> Tuple[Entry, List[WriteReq]]:
+    if PrimitiveEntry.supported(obj):
+        return PrimitiveEntry.from_object(obj, replicated=replicated), []
+
+    if isinstance(obj, np.generic):  # numpy scalar → 0-d array
+        obj = np.asarray(obj)
+
+    if isinstance(obj, jax.Array) and is_sharded(obj):
+        storage_path = get_storage_path(logical_path, rank, False, sharded=True)
+        return ShardedArrayIOPreparer.prepare_write(
+            storage_path, obj, is_async_snapshot=is_async_snapshot
+        )
+
+    if isinstance(obj, (jax.Array, np.ndarray)) and is_supported_array_dtype(obj):
+        storage_path = get_storage_path(logical_path, rank, replicated, sharded=False)
+        if should_chunk(obj):
+            return ChunkedArrayIOPreparer.prepare_write(
+                storage_path, obj, replicated, is_async_snapshot
+            )
+        return ArrayIOPreparer.prepare_write(
+            storage_path, obj, replicated, is_async_snapshot
+        )
+
+    storage_path = get_storage_path(logical_path, rank, replicated, sharded=False)
+    return ObjectIOPreparer.prepare_write(storage_path, obj, replicated)
+
+
+def prepare_read(
+    entry: Entry,
+    obj_out: Any = None,
+    buffer_size_limit_bytes: Optional[int] = None,
+) -> Tuple[List[ReadReq], Future]:
+    if isinstance(entry, PrimitiveEntry):
+        return [], Future(obj=entry.get_value())
+    if isinstance(entry, ShardedEntry):
+        return ShardedArrayIOPreparer.prepare_read(
+            entry, obj_out, buffer_size_limit_bytes
+        )
+    if isinstance(entry, ChunkedTensorEntry):
+        return ChunkedArrayIOPreparer.prepare_read(
+            entry, obj_out, buffer_size_limit_bytes
+        )
+    if isinstance(entry, TensorEntry):
+        return ArrayIOPreparer.prepare_read(entry, obj_out, buffer_size_limit_bytes)
+    if isinstance(entry, ObjectEntry):
+        return ObjectIOPreparer.prepare_read(entry)
+    raise TypeError(f"Cannot prepare read for entry type {type(entry).__name__}")

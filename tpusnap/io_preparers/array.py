@@ -1,0 +1,296 @@
+"""Dense-array IO preparer — the inner loop of every snapshot.
+
+TPU-native counterpart of /root/reference/torchsnapshot/io_preparers/tensor.py.
+Where the reference stages with ``Tensor.to("cpu")`` in GIL-released
+TorchScript (tensor.py:247-305,351-358), this preparer uses XLA's async
+device→host DMA: ``jax.Array.copy_to_host_async()`` is enqueued at prepare
+time so the DMA overlaps with scheduling, and the thread-pooled
+``np.asarray`` in ``stage_buffer`` then finds the host copy ready (numpy
+releases the GIL for the copy; the PJRT transfer releases it too).
+
+Differences by design:
+- JAX arrays are immutable, so the reference's in-place load
+  (tensor.py:101,188-196) becomes: build a zero-copy numpy view over the
+  read buffer and ``jax.device_put`` it with the restore target's
+  sharding; for numpy targets we np.copyto in place.
+- The async-snapshot defensive clone (tensor.py:281-305) is a host-side
+  ``bytes()`` copy: on CPU backends ``np.asarray(jax_array)`` may alias
+  the device buffer, which a donated update could overwrite.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from concurrent.futures import Executor
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..io_types import (
+    BufferConsumer,
+    BufferStager,
+    BufferType,
+    Future,
+    ReadReq,
+    WriteReq,
+)
+from ..manifest import TensorEntry
+from ..serialization import (
+    Serializer,
+    array_as_memoryview,
+    array_from_memoryview,
+    dtype_to_string,
+    tensor_nbytes,
+)
+
+ArrayLike = object  # jax.Array | np.ndarray
+
+
+def array_nbytes(arr: ArrayLike) -> int:
+    return int(np.prod(arr.shape)) * np.dtype(arr.dtype).itemsize if arr.shape else np.dtype(arr.dtype).itemsize
+
+
+def is_supported_array_dtype(arr: ArrayLike) -> bool:
+    try:
+        dtype_to_string(arr.dtype)
+        return True
+    except ValueError:
+        return False
+
+
+def enqueue_dtoh(arr: ArrayLike) -> None:
+    """Start the device→host DMA early (overlaps with scheduling)."""
+    if isinstance(arr, jax.Array):
+        try:
+            arr.copy_to_host_async()
+        except Exception:
+            pass  # some platforms/arrays don't support it; asarray will block
+
+
+class ArrayBufferStager(BufferStager):
+    def __init__(self, arr: ArrayLike, is_async_snapshot: bool = False) -> None:
+        self.arr = arr
+        self.is_async_snapshot = is_async_snapshot
+        enqueue_dtoh(arr)
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            return await loop.run_in_executor(executor, self._stage_blocking)
+        return self._stage_blocking()
+
+    def _stage_blocking(self) -> BufferType:
+        host = np.asarray(self.arr)  # DtoH (no-op if DMA already done)
+        mv = array_as_memoryview(host)
+        if self.is_async_snapshot and _may_alias_live_memory(self.arr, host):
+            # Defensive clone: training resumes before I/O completes, and a
+            # donated buffer could be overwritten under us.
+            return bytearray(mv)
+        return mv
+
+    def get_staging_cost_bytes(self) -> int:
+        n = array_nbytes(self.arr)
+        # async snapshots hold a second host copy while in flight
+        return 2 * n if self.is_async_snapshot else n
+
+
+def _may_alias_live_memory(arr: ArrayLike, host: np.ndarray) -> bool:
+    if isinstance(arr, jax.Array):
+        return True  # conservatively assume the host view aliases XLA memory
+    # numpy source: the memoryview aliases the caller's array by construction
+    return True
+
+
+class ArrayBufferConsumer(BufferConsumer):
+    """Deserializes into the restore target. For jax targets the result is
+    device_put with the target's sharding; numpy targets are filled in
+    place (the reference's in-place load, tensor.py:188-196)."""
+
+    def __init__(self, entry: TensorEntry, obj_out: Optional[ArrayLike], fut: Future):
+        self.entry = entry
+        self.obj_out = obj_out
+        self.fut = fut
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            await loop.run_in_executor(executor, self._consume_blocking, buf)
+        else:
+            self._consume_blocking(buf)
+
+    def _consume_blocking(self, buf: BufferType) -> None:
+        value = materialize_array(self.entry, buf, self.obj_out)
+        self.fut.obj = value
+
+    def get_consuming_cost_bytes(self) -> int:
+        return tensor_nbytes(self.entry.dtype, self.entry.shape)
+
+
+def materialize_array(
+    entry: TensorEntry, buf: BufferType, obj_out: Optional[ArrayLike]
+) -> ArrayLike:
+    src = array_from_memoryview(memoryview(buf), entry.dtype, entry.shape)
+    if isinstance(obj_out, np.ndarray):
+        if (
+            obj_out.dtype == src.dtype
+            and obj_out.shape == src.shape
+            and obj_out.flags.writeable
+        ):
+            np.copyto(obj_out, src)
+            return obj_out
+        return src.copy()
+    if isinstance(obj_out, jax.Array):
+        # Restore with the target's sharding/placement. device_put is async;
+        # XLA overlaps the HtoD DMA with subsequent reads.
+        return jax.device_put(src, obj_out.sharding)
+    # No target: plain host array (owns its memory — `src` aliases the
+    # read buffer which is about to be released).
+    return src.copy()
+
+
+class ArrayIOPreparer:
+    """prepare_write/prepare_read for dense (single-blob) arrays
+    (reference TensorIOPreparer, io_preparers/tensor.py:47-222)."""
+
+    @staticmethod
+    def prepare_write(
+        storage_path: str,
+        arr: ArrayLike,
+        replicated: bool = False,
+        is_async_snapshot: bool = False,
+    ) -> Tuple[TensorEntry, List[WriteReq]]:
+        entry = TensorEntry(
+            location=storage_path,
+            serializer=Serializer.BUFFER_PROTOCOL.value,
+            dtype=dtype_to_string(arr.dtype),
+            shape=list(arr.shape),
+            replicated=replicated,
+        )
+        write_reqs = [
+            WriteReq(
+                path=storage_path,
+                buffer_stager=ArrayBufferStager(arr, is_async_snapshot),
+            )
+        ]
+        return entry, write_reqs
+
+    @staticmethod
+    def prepare_read(
+        entry: TensorEntry,
+        obj_out: Optional[ArrayLike] = None,
+        buffer_size_limit_bytes: Optional[int] = None,
+    ) -> Tuple[List[ReadReq], Future]:
+        fut: Future = Future()
+        nbytes = tensor_nbytes(entry.dtype, entry.shape)
+        if (
+            buffer_size_limit_bytes is not None
+            and nbytes > buffer_size_limit_bytes
+            and len(entry.shape) > 0
+            and entry.shape[0] > 1
+        ):
+            return ArrayIOPreparer._prepare_tiled_read(
+                entry, obj_out, buffer_size_limit_bytes, fut
+            )
+        byte_range = tuple(entry.byte_range) if entry.byte_range is not None else None
+        read_reqs = [
+            ReadReq(
+                path=entry.location,
+                byte_range=byte_range,
+                buffer_consumer=ArrayBufferConsumer(entry, obj_out, fut),
+            )
+        ]
+        return read_reqs, fut
+
+    @staticmethod
+    def _prepare_tiled_read(
+        entry: TensorEntry,
+        obj_out: Optional[ArrayLike],
+        buffer_size_limit_bytes: int,
+        fut: Future,
+    ) -> Tuple[List[ReadReq], Future]:
+        """Split one tensor read into byte-ranged row tiles so peak host
+        memory stays under the budget (reference tensor.py:126-179).
+
+        The tiles are copied into one preallocated host array; the future
+        resolves when the last tile lands.
+        """
+        shape = entry.shape
+        row_nbytes = tensor_nbytes(entry.dtype, shape[1:]) if len(shape) > 1 else tensor_nbytes(entry.dtype, [1])
+        rows_per_tile = max(1, buffer_size_limit_bytes // max(row_nbytes, 1))
+        n_rows = shape[0]
+
+        # Preallocated host destination; tiles land in place.
+        if isinstance(obj_out, np.ndarray) and (
+            dtype_to_string(obj_out.dtype) == entry.dtype
+            and list(obj_out.shape) == list(shape)
+            and obj_out.flags.writeable
+        ):
+            host_out = obj_out
+            in_place = True
+        else:
+            from ..serialization import string_to_dtype
+
+            host_out = np.empty(shape, dtype=string_to_dtype(entry.dtype))
+            in_place = False
+
+        base_offset = entry.byte_range[0] if entry.byte_range is not None else 0
+        n_tiles = math.ceil(n_rows / rows_per_tile)
+        remaining = {"count": n_tiles}
+        read_reqs = []
+        for t in range(n_tiles):
+            r0 = t * rows_per_tile
+            r1 = min(r0 + rows_per_tile, n_rows)
+            start = base_offset + r0 * row_nbytes
+            end = base_offset + r1 * row_nbytes
+            read_reqs.append(
+                ReadReq(
+                    path=entry.location,
+                    byte_range=(start, end),
+                    buffer_consumer=_TileConsumer(
+                        entry, host_out, r0, r1, remaining, fut, obj_out, in_place
+                    ),
+                )
+            )
+        return read_reqs, fut
+
+
+class _TileConsumer(BufferConsumer):
+    def __init__(self, entry, host_out, r0, r1, remaining, fut, obj_out, in_place):
+        self.entry = entry
+        self.host_out = host_out
+        self.r0, self.r1 = r0, r1
+        self.remaining = remaining
+        self.fut = fut
+        self.obj_out = obj_out
+        self.in_place = in_place
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            await loop.run_in_executor(executor, self._consume_blocking, buf)
+        else:
+            self._consume_blocking(buf)
+
+    def _consume_blocking(self, buf: BufferType) -> None:
+        tile_shape = [self.r1 - self.r0] + list(self.entry.shape[1:])
+        src = array_from_memoryview(memoryview(buf), self.entry.dtype, tile_shape)
+        np.copyto(self.host_out[self.r0 : self.r1], src)
+        self.remaining["count"] -= 1
+        if self.remaining["count"] == 0:
+            if self.in_place:
+                self.fut.obj = self.host_out
+            elif isinstance(self.obj_out, jax.Array):
+                self.fut.obj = jax.device_put(self.host_out, self.obj_out.sharding)
+            else:
+                self.fut.obj = self.host_out
+
+    def get_consuming_cost_bytes(self) -> int:
+        return tensor_nbytes(
+            self.entry.dtype, [self.r1 - self.r0] + list(self.entry.shape[1:])
+        )

@@ -1,0 +1,81 @@
+"""Fallback preparer for arbitrary picklable objects.
+
+Counterpart of /root/reference/torchsnapshot/io_preparers/object.py
+(which uses torch.save — also pickle underneath). Costs are approximated
+with sys.getsizeof before serialization, as in the reference (:76-78).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from concurrent.futures import Executor
+from typing import Any, List, Optional, Tuple
+
+from ..io_types import (
+    BufferConsumer,
+    BufferStager,
+    BufferType,
+    Future,
+    ReadReq,
+    WriteReq,
+)
+from ..manifest import ObjectEntry
+from ..serialization import Serializer, pickle_as_bytes, pickle_from_bytes
+
+
+class ObjectBufferStager(BufferStager):
+    def __init__(self, obj: Any) -> None:
+        self.obj = obj
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            return await loop.run_in_executor(executor, pickle_as_bytes, self.obj)
+        return pickle_as_bytes(self.obj)
+
+    def get_staging_cost_bytes(self) -> int:
+        return sys.getsizeof(self.obj)
+
+
+class ObjectBufferConsumer(BufferConsumer):
+    def __init__(self, fut: Future) -> None:
+        self.fut = fut
+        self._estimated_cost = 0
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            self.fut.obj = await loop.run_in_executor(
+                executor, pickle_from_bytes, bytes(buf)
+            )
+        else:
+            self.fut.obj = pickle_from_bytes(bytes(buf))
+
+    def get_consuming_cost_bytes(self) -> int:
+        return max(self._estimated_cost, 1)
+
+
+class ObjectIOPreparer:
+    @staticmethod
+    def prepare_write(
+        storage_path: str, obj: Any, replicated: bool = False
+    ) -> Tuple[ObjectEntry, List[WriteReq]]:
+        entry = ObjectEntry(
+            location=storage_path,
+            serializer=Serializer.PICKLE.value,
+            obj_type=type(obj).__name__,
+            replicated=replicated,
+        )
+        return entry, [
+            WriteReq(path=storage_path, buffer_stager=ObjectBufferStager(obj))
+        ]
+
+    @staticmethod
+    def prepare_read(entry: ObjectEntry) -> Tuple[List[ReadReq], Future]:
+        fut: Future = Future()
+        return [
+            ReadReq(path=entry.location, buffer_consumer=ObjectBufferConsumer(fut))
+        ], fut

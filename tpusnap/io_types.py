@@ -1,0 +1,140 @@
+"""Core I/O abstractions: write/read requests, stagers/consumers, and the
+StoragePlugin ABC.
+
+TPU-native counterpart of /root/reference/torchsnapshot/io_types.py:
+same pipeline roles —
+
+- ``WriteReq``  = logical path + ``BufferStager`` (produces bytes, e.g. by
+  device→host DMA + zero-copy serialization).
+- ``ReadReq``   = logical path + optional byte range + ``BufferConsumer``
+  (deserializes into the restore target in place).
+- ``WriteIO``/``ReadIO`` = the physical request handed to a storage plugin.
+- ``StoragePlugin`` = async write/read/delete/close + sync shims.
+
+Staging/consuming cost models drive the scheduler's memory budget
+(reference io_types.py:30-72).
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import io
+from concurrent.futures import Executor
+from dataclasses import dataclass, field
+from typing import Generic, List, Optional, Tuple, TypeVar, Union
+
+BufferType = Union[bytes, bytearray, memoryview]
+
+T = TypeVar("T")
+
+
+class Future(Generic[T]):
+    """Tiny completion cell for values materialized during read execution
+    (reference io_preparer returns ``Future`` for inflated objects)."""
+
+    def __init__(self, obj: Optional[T] = None) -> None:
+        self.obj = obj
+
+
+@dataclass
+class WriteIO:
+    path: str
+    buf: BufferType
+
+
+@dataclass
+class ReadIO:
+    path: str
+    byte_range: Optional[Tuple[int, int]] = None
+    buf: io.BytesIO = field(default_factory=io.BytesIO)
+
+
+class BufferStager(abc.ABC):
+    @abc.abstractmethod
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        """Produce the bytes to persist (may run DtoH copies in ``executor``)."""
+
+    @abc.abstractmethod
+    def get_staging_cost_bytes(self) -> int:
+        """Peak host memory consumed while this buffer is staged."""
+
+
+@dataclass
+class WriteReq:
+    path: str
+    buffer_stager: BufferStager
+
+
+class BufferConsumer(abc.ABC):
+    @abc.abstractmethod
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        """Deserialize ``buf`` into the restore target."""
+
+    @abc.abstractmethod
+    def get_consuming_cost_bytes(self) -> int:
+        """Peak host memory consumed while this buffer is being consumed."""
+
+
+@dataclass
+class ReadReq:
+    path: str
+    buffer_consumer: BufferConsumer
+    byte_range: Optional[Tuple[int, int]] = None
+
+
+class StoragePlugin(abc.ABC):
+    """Storage backend. Implementations must be safe for many concurrent
+    coroutines (the scheduler keeps up to 16 requests in flight)."""
+
+    @abc.abstractmethod
+    async def write(self, write_io: WriteIO) -> None: ...
+
+    @abc.abstractmethod
+    async def read(self, read_io: ReadIO) -> None: ...
+
+    @abc.abstractmethod
+    async def delete(self, path: str) -> None: ...
+
+    async def close(self) -> None:  # optional override
+        return None
+
+    # Sync shims (reference io_types.py:96-111): convenience wrappers used
+    # outside the scheduler's event loop (metadata read/write).
+    def sync_write(
+        self, write_io: WriteIO, event_loop: Optional[asyncio.AbstractEventLoop] = None
+    ) -> None:
+        _run(self.write(write_io), event_loop)
+
+    def sync_read(
+        self, read_io: ReadIO, event_loop: Optional[asyncio.AbstractEventLoop] = None
+    ) -> None:
+        _run(self.read(read_io), event_loop)
+
+    def sync_delete(
+        self, path: str, event_loop: Optional[asyncio.AbstractEventLoop] = None
+    ) -> None:
+        _run(self.delete(path), event_loop)
+
+    def sync_close(
+        self, event_loop: Optional[asyncio.AbstractEventLoop] = None
+    ) -> None:
+        _run(self.close(), event_loop)
+
+
+def _run(coro, event_loop: Optional[asyncio.AbstractEventLoop]) -> None:
+    if event_loop is not None:
+        event_loop.run_until_complete(coro)
+    else:
+        asyncio.run(coro)
+
+
+def read_io_bytes(read_io: ReadIO) -> memoryview:
+    """The bytes a plugin filled into a ReadIO."""
+    return read_io.buf.getbuffer()
+
+
+def total_write_bytes(write_ios: List[WriteIO]) -> int:
+    return sum(len(w.buf) for w in write_ios)

@@ -133,19 +133,10 @@ class ShardedEntry(Entry):
         )
 
 
-@dataclass
-class Chunk:
-    offsets: List[int]
-    sizes: List[int]
-    tensor: TensorEntry
-
-    @classmethod
-    def from_dict(cls, d: Dict[str, Any]) -> "Chunk":
-        return cls(
-            offsets=list(d["offsets"]),
-            sizes=list(d["sizes"]),
-            tensor=TensorEntry.from_dict(d["tensor"]),
-        )
+# A chunk of a ChunkedTensorEntry has the same (offsets, sizes, tensor)
+# structure as a shard; reuse the type (reference manifest.py:113-116 types
+# chunks as List[Shard] for the same reason).
+Chunk = Shard
 
 
 @dataclass
@@ -339,7 +330,7 @@ _ENTRY_TYPES = {
 
 def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
     def convert(v: Any) -> Any:
-        if isinstance(v, (Shard, Chunk)):
+        if isinstance(v, Shard):
             return {
                 "offsets": v.offsets,
                 "sizes": v.sizes,

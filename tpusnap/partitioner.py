@@ -1,0 +1,168 @@
+"""Write-load partitioner: spreads replicated write requests across ranks.
+
+Counterpart of /root/reference/torchsnapshot/partitioner.py:24-316. Every
+rank holds an identical copy of each replicated value, so any rank can
+write it; the partitioner makes sure each replicated unit is written by
+exactly one rank, chosen greedily so total write load balances:
+
+- units: one per replicated entry; chunked tensors subpartition per-chunk
+  (reference :42-79);
+- per-rank starting load = that rank's non-replicated write bytes
+  (all-gathered, reference :122-129);
+- rank 0 assigns each unit (largest first) to the currently least-loaded
+  rank and broadcasts the assignment (reference :144);
+- each rank keeps only the write requests assigned to it. Manifest
+  consolidation picks the writer's entry version (which may have been
+  slab-batched) — see ``consolidate_replicated_entries``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple, Union
+
+from .comm import Communicator
+from .knobs import is_partitioner_disabled
+from .manifest import ChunkedTensorEntry, Entry, Manifest, is_replicated
+from .io_types import WriteReq
+
+logger = logging.getLogger(__name__)
+
+# A unit key is either a logical path (atomic entries) or
+# (logical_path, chunk_location) for per-chunk units.
+UnitKey = Union[str, Tuple[str, str]]
+
+
+def _collect_units(
+    entries: Manifest, replicated_paths: List[str], write_req_costs: Dict[str, int]
+) -> List[Tuple[UnitKey, List[str], int]]:
+    """[(unit_key, [write_req_path], load_bytes)] for replicated entries."""
+    units: List[Tuple[UnitKey, List[str], int]] = []
+    for logical_path in replicated_paths:
+        entry = entries[logical_path]
+        if isinstance(entry, ChunkedTensorEntry):
+            for chunk in entry.chunks:
+                loc = chunk.tensor.location
+                units.append(
+                    ((logical_path, loc), [loc], write_req_costs.get(loc, 0))
+                )
+        else:
+            loc = getattr(entry, "location", None)
+            if loc is None:
+                continue
+            units.append((logical_path, [loc], write_req_costs.get(loc, 0)))
+    return units
+
+
+def partition_write_reqs(
+    entries: Manifest,
+    write_reqs: List[WriteReq],
+    replicated_paths: List[str],
+    comm: Communicator,
+) -> List[WriteReq]:
+    """Drop replicated write requests not assigned to this rank. Entries
+    are left untouched (locations are rank-agnostic)."""
+    if comm.world_size == 1 or not replicated_paths or is_partitioner_disabled():
+        return write_reqs
+
+    write_req_costs = {
+        wr.path: wr.buffer_stager.get_staging_cost_bytes() for wr in write_reqs
+    }
+    units = _collect_units(entries, sorted(replicated_paths), write_req_costs)
+    replicated_req_paths = {p for _, paths, _ in units for p in paths}
+
+    # Starting load: this rank's non-replicated write bytes.
+    own_load = sum(
+        cost
+        for path, cost in write_req_costs.items()
+        if path not in replicated_req_paths
+    )
+    all_loads = comm.all_gather_object(own_load)
+
+    if comm.rank == 0:
+        assignment = _greedy_assign(units, all_loads)
+    else:
+        assignment = None
+    assignment = comm.broadcast_object(assignment, src=0)
+
+    keep_paths = {
+        path
+        for (unit_key, paths, _) in units
+        for path in paths
+        if assignment[_unit_id(unit_key)] == comm.rank
+    }
+    return [
+        wr
+        for wr in write_reqs
+        if wr.path not in replicated_req_paths or wr.path in keep_paths
+    ]
+
+
+def _unit_id(unit_key: UnitKey) -> str:
+    return unit_key if isinstance(unit_key, str) else f"{unit_key[0]}::{unit_key[1]}"
+
+
+def _greedy_assign(
+    units: List[Tuple[UnitKey, List[str], int]], loads: List[int]
+) -> Dict[str, int]:
+    """Largest-first argmin-greedy assignment (reference :42-79)."""
+    loads = list(loads)
+    assignment: Dict[str, int] = {}
+    for unit_key, _, cost in sorted(units, key=lambda u: u[2], reverse=True):
+        target = min(range(len(loads)), key=lambda r: loads[r])
+        loads[target] += cost
+        assignment[_unit_id(unit_key)] = target
+    return assignment
+
+
+def consolidate_replicated_entries(
+    per_rank_entries: List[Manifest],
+    replicated_paths_per_rank: Optional[List[List[str]]] = None,
+) -> Manifest:
+    """Merge per-rank manifests into the global ``rank/path``-keyed
+    manifest, deduping replicated entries onto rank 0's tree while
+    preferring the *writer's* entry version (whose location/byte_range
+    reflect slab batching) — reference partitioner.py:236-303.
+
+    The writer's version is recognized without carrying the assignment
+    around: exactly one rank's copy of a replicated entry was rewritten
+    by its batcher (location under ``batched/``) or, if unbatched, all
+    copies are identical so any works. Chunked entries merge per-chunk
+    the same way.
+    """
+    global_manifest: Manifest = {}
+    world_size = len(per_rank_entries)
+
+    # Pass 1: find the authoritative version of each replicated path.
+    authoritative: Dict[str, Entry] = {}
+    for r in range(world_size):
+        for path, entry in per_rank_entries[r].items():
+            if not is_replicated(entry):
+                continue
+            if path not in authoritative:
+                authoritative[path] = entry
+                continue
+            current = authoritative[path]
+            if isinstance(entry, ChunkedTensorEntry) and isinstance(
+                current, ChunkedTensorEntry
+            ):
+                # Per-chunk: prefer batched (slab-located) chunk versions.
+                merged_chunks = []
+                for cur_chunk, new_chunk in zip(current.chunks, entry.chunks):
+                    merged_chunks.append(
+                        new_chunk
+                        if new_chunk.tensor.location.startswith("batched/")
+                        else cur_chunk
+                    )
+                current.chunks = merged_chunks
+            elif getattr(entry, "location", "").startswith("batched/"):
+                authoritative[path] = entry
+
+    for r in range(world_size):
+        for path, entry in per_rank_entries[r].items():
+            if is_replicated(entry):
+                if r == 0:
+                    global_manifest[f"0/{path}"] = authoritative[path]
+                continue
+            global_manifest[f"{r}/{path}"] = entry
+    return global_manifest

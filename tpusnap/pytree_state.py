@@ -1,0 +1,38 @@
+"""PytreeState — wrap any JAX pytree (flax params, optax optimizer state,
+a TrainState) as a Stateful.
+
+tpusnap extension with no reference counterpart: the reference leans on
+torch modules implementing state_dict() themselves; JAX state is plain
+pytrees. ``state_dict`` exposes the tree as nested containers (dict/list/
+tuple — NamedTuples and custom pytree nodes flatten through
+``jax.tree_util``), and ``load_state_dict`` restores values while
+preserving the ORIGINAL tree structure, so NamedTuple/custom-node types
+survive the round-trip even though the snapshot stores generic containers.
+"""
+
+from typing import Any, Dict
+
+import jax
+
+
+class PytreeState:
+    def __init__(self, tree: Any) -> None:
+        self._tree = tree
+
+    @property
+    def tree(self) -> Any:
+        return self._tree
+
+    def state_dict(self) -> Dict[str, Any]:
+        leaves = jax.tree_util.tree_leaves(self._tree)
+        return {"leaves": leaves}
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        treedef = jax.tree_util.tree_structure(self._tree)
+        leaves = state_dict["leaves"]
+        if treedef.num_leaves != len(leaves):
+            raise ValueError(
+                f"Snapshot holds {len(leaves)} leaves but the target pytree "
+                f"has {treedef.num_leaves}"
+            )
+        self._tree = jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,28 @@
+"""RNG state capture with take/restore invariance.
+
+Counterpart of /root/reference/torchsnapshot/rng_state.py:13. JAX's own
+RNG is explicit (PRNG keys live in user state and are checkpointed as
+ordinary arrays), so the global RNGs worth capturing on the host are
+python's ``random`` and numpy's legacy global generator. The invariant
+enforced by Snapshot (reference snapshot.py:338-374) is preserved: taking
+a snapshot leaves RNG state exactly as it was, and restoring reproduces
+the state at save time.
+"""
+
+import pickle
+import random
+from typing import Any, Dict
+
+import numpy as np
+
+
+class RNGState:
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "python_random": pickle.dumps(random.getstate()),
+            "numpy_random": pickle.dumps(np.random.get_state()),
+        }
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        random.setstate(pickle.loads(state_dict["python_random"]))
+        np.random.set_state(pickle.loads(state_dict["numpy_random"]))

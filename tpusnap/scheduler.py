@@ -1,0 +1,323 @@
+"""Async execution engine: budget-gated, pipelined staging and storage I/O.
+
+TPU-native counterpart of /root/reference/torchsnapshot/scheduler.py.
+Semantics preserved:
+
+- Write path (scheduler.py:220-337): each WriteReq becomes a pipeline moving
+  ready_for_staging → staging → ready_for_io → io → done. Staging (device→
+  host DMA + serialization, in a thread pool with the GIL released by
+  numpy/ctypes/XLA) is dispatched only while the outstanding staging cost
+  fits the memory budget — but at least one request is always allowed so a
+  single over-budget item can't deadlock (scheduler.py:264-275). Storage
+  I/O keeps ≤16 requests in flight; staging uses ≤4 threads.
+- ``execute_write_reqs`` returns once **staging** completes — the snapshot
+  is then consistent (buffers no longer alias live arrays) and residual
+  storage I/O is handed back as ``PendingIOWork`` (scheduler.py:178-217),
+  which ``take`` drains synchronously and ``async_take`` drains in a
+  background thread.
+- Read path mirrors it (scheduler.py:357-444): read (≤16 concurrent,
+  budget-gated on consuming cost) ∥ consume (deserialize + copy into the
+  restore target, thread pool).
+- Memory budget = min(0.6 × available host RAM / local_world_size, 32GB),
+  env-overridable; local world size discovered by all-gathering hostnames
+  (scheduler.py:27-65).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Awaitable, List, Optional, Set
+
+import psutil
+
+from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
+from .knobs import get_memory_budget_override_bytes
+
+logger = logging.getLogger(__name__)
+
+_MAX_IO_CONCURRENCY = 16
+_MAX_CPU_CONCURRENCY = 4
+_MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
+_AVAILABLE_MEMORY_FRACTION = 0.6
+_REPORT_INTERVAL_SEC = 10.0
+
+
+def get_process_memory_budget_bytes(comm=None) -> int:
+    """Per-process host-memory budget for staging/consuming buffers
+    (reference scheduler.py:45-65)."""
+    override = get_memory_budget_override_bytes()
+    if override is not None:
+        return override
+    if comm is not None and comm.world_size > 1:
+        hostnames = comm.all_gather_object(socket.gethostname())
+        local_world_size = hostnames.count(socket.gethostname())
+    else:
+        local_world_size = 1
+    available = psutil.virtual_memory().available
+    budget = int(available * _AVAILABLE_MEMORY_FRACTION / max(local_world_size, 1))
+    return min(budget, _MAX_PER_RANK_MEMORY_BUDGET_BYTES)
+
+
+class _Reporter:
+    """Periodic pipeline progress logging (reference scheduler.py:96-175)."""
+
+    def __init__(self, rank: int, verb: str, total_reqs: int) -> None:
+        self.rank = rank
+        self.verb = verb
+        self.total_reqs = total_reqs
+        self.begin_ts = time.monotonic()
+        self.last_report_ts = self.begin_ts
+        self.bytes_done = 0
+        self.reqs_done = 0
+        self.rss_begin = psutil.Process().memory_info().rss
+
+    def report_request_done(self, nbytes: int) -> None:
+        self.reqs_done += 1
+        self.bytes_done += nbytes
+        now = time.monotonic()
+        if now - self.last_report_ts >= _REPORT_INTERVAL_SEC:
+            self.last_report_ts = now
+            rss_delta = psutil.Process().memory_info().rss - self.rss_begin
+            logger.info(
+                "Rank %d: %s %d/%d reqs, %.2f GB, %.1f MB/s, rss delta %.0f MB",
+                self.rank,
+                self.verb,
+                self.reqs_done,
+                self.total_reqs,
+                self.bytes_done / 1e9,
+                self.bytes_done / 1e6 / max(now - self.begin_ts, 1e-9),
+                rss_delta / 1e6,
+            )
+
+    def summarize(self) -> None:
+        elapsed = max(time.monotonic() - self.begin_ts, 1e-9)
+        logger.info(
+            "Rank %d: %s complete: %d reqs, %.2f GB in %.2fs (%.1f MB/s)",
+            self.rank,
+            self.verb,
+            self.reqs_done,
+            self.bytes_done / 1e9,
+            elapsed,
+            self.bytes_done / 1e6 / elapsed,
+        )
+
+
+@dataclass
+class PendingIOWork:
+    """Residual storage I/O after staging completed (reference
+    scheduler.py:178-217)."""
+
+    io_tasks: Set[asyncio.Task] = field(default_factory=set)
+    executor: Optional[ThreadPoolExecutor] = None
+    reporter: Optional[_Reporter] = None
+
+    async def complete(self) -> None:
+        try:
+            if self.io_tasks:
+                await asyncio.gather(*self.io_tasks)
+        finally:
+            if self.executor is not None:
+                self.executor.shutdown(wait=True)
+        if self.reporter is not None:
+            self.reporter.summarize()
+
+    def sync_complete(self, event_loop: asyncio.AbstractEventLoop) -> None:
+        event_loop.run_until_complete(self.complete())
+
+
+class _WritePipeline:
+    def __init__(self, write_req: WriteReq, storage: StoragePlugin) -> None:
+        self.write_req = write_req
+        self.storage = storage
+        self.staging_cost = write_req.buffer_stager.get_staging_cost_bytes()
+        self.buf = None
+        self.buf_size = 0
+
+    async def stage(self, executor: ThreadPoolExecutor) -> "_WritePipeline":
+        self.buf = await self.write_req.buffer_stager.stage_buffer(executor)
+        self.buf_size = len(memoryview(self.buf).cast("B")) if self.buf else 0
+        return self
+
+    async def write(self) -> "_WritePipeline":
+        await self.storage.write(WriteIO(path=self.write_req.path, buf=self.buf))
+        self.buf = None  # release host memory
+        return self
+
+
+async def execute_write_reqs(
+    write_reqs: List[WriteReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+) -> PendingIOWork:
+    executor = ThreadPoolExecutor(
+        max_workers=_MAX_CPU_CONCURRENCY, thread_name_prefix="tpusnap-stage"
+    )
+    reporter = _Reporter(rank=rank, verb="write", total_reqs=len(write_reqs))
+    # Stage large requests first: they occupy budget longest and their I/O
+    # overlaps with the staging of everything behind them.
+    pipelines = deque(
+        sorted(
+            (_WritePipeline(wr, storage) for wr in write_reqs),
+            key=lambda p: p.staging_cost,
+            reverse=True,
+        )
+    )
+    budget = memory_budget_bytes
+    staging_tasks: Set[asyncio.Task] = set()
+    io_tasks: Set[asyncio.Task] = set()
+
+    def dispatch_staging() -> None:
+        nonlocal budget
+        while pipelines and len(staging_tasks) < _MAX_CPU_CONCURRENCY:
+            head = pipelines[0]
+            in_flight = staging_tasks or io_tasks
+            if head.staging_cost > budget and in_flight:
+                break  # wait for memory to free up
+            pipelines.popleft()
+            budget -= head.staging_cost
+            staging_tasks.add(asyncio.ensure_future(head.stage(executor)))
+
+    def dispatch_io(ready: List[_WritePipeline]) -> None:
+        while ready and len(io_tasks) < _MAX_IO_CONCURRENCY:
+            io_tasks.add(asyncio.ensure_future(ready.pop(0).write()))
+
+    ready_for_io: List[_WritePipeline] = []
+    dispatch_staging()
+    while staging_tasks or pipelines:
+        done, _ = await asyncio.wait(
+            staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
+        )
+        for task in done:
+            if task in staging_tasks:
+                staging_tasks.discard(task)
+                pipeline = task.result()  # re-raises staging failure
+                # Staged buffer may be smaller than the staging cost
+                # (e.g. cost model overestimates); credit the difference.
+                budget += pipeline.staging_cost - pipeline.buf_size
+                ready_for_io.append(pipeline)
+            elif task in io_tasks:
+                io_tasks.discard(task)
+                pipeline = task.result()
+                budget += pipeline.buf_size
+                reporter.report_request_done(pipeline.buf_size)
+        dispatch_io(ready_for_io)
+        dispatch_staging()
+
+    # Staging complete: snapshot content is now frozen. Remaining I/O is
+    # handed back so the caller decides whether to drain it in the
+    # foreground (take) or a background thread (async_take).
+    async def _drain_rest(pipeline: _WritePipeline) -> None:
+        await pipeline.write()
+        reporter.report_request_done(pipeline.buf_size)
+
+    for pipeline in ready_for_io:
+        io_tasks.add(asyncio.ensure_future(_drain_rest(pipeline)))
+    return PendingIOWork(io_tasks=io_tasks, executor=executor, reporter=reporter)
+
+
+def sync_execute_write_reqs(
+    write_reqs: List[WriteReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+    event_loop: asyncio.AbstractEventLoop,
+) -> PendingIOWork:
+    return event_loop.run_until_complete(
+        execute_write_reqs(write_reqs, storage, memory_budget_bytes, rank)
+    )
+
+
+class _ReadPipeline:
+    def __init__(self, read_req: ReadReq, storage: StoragePlugin) -> None:
+        self.read_req = read_req
+        self.storage = storage
+        self.consuming_cost = read_req.buffer_consumer.get_consuming_cost_bytes()
+        self.read_io: Optional[ReadIO] = None
+
+    async def read(self) -> "_ReadPipeline":
+        self.read_io = ReadIO(
+            path=self.read_req.path, byte_range=self.read_req.byte_range
+        )
+        await self.storage.read(self.read_io)
+        return self
+
+    async def consume(self, executor: ThreadPoolExecutor) -> "_ReadPipeline":
+        buf = self.read_io.buf.getbuffer()
+        await self.read_req.buffer_consumer.consume_buffer(buf, executor)
+        self.read_io = None  # release
+        return self
+
+
+async def execute_read_reqs(
+    read_reqs: List[ReadReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+) -> None:
+    executor = ThreadPoolExecutor(
+        max_workers=_MAX_CPU_CONCURRENCY, thread_name_prefix="tpusnap-consume"
+    )
+    reporter = _Reporter(rank=rank, verb="read", total_reqs=len(read_reqs))
+    pipelines = deque(
+        sorted(
+            (_ReadPipeline(rr, storage) for rr in read_reqs),
+            key=lambda p: p.consuming_cost,
+            reverse=True,
+        )
+    )
+    budget = memory_budget_bytes
+    read_tasks: Set[asyncio.Task] = set()
+    consume_tasks: Set[asyncio.Task] = set()
+
+    def dispatch_reads() -> None:
+        nonlocal budget
+        while pipelines and len(read_tasks) < _MAX_IO_CONCURRENCY:
+            head = pipelines[0]
+            in_flight = read_tasks or consume_tasks
+            if head.consuming_cost > budget and in_flight:
+                break
+            pipelines.popleft()
+            budget -= head.consuming_cost
+            read_tasks.add(asyncio.ensure_future(head.read()))
+
+    try:
+        dispatch_reads()
+        while read_tasks or consume_tasks or pipelines:
+            done, _ = await asyncio.wait(
+                read_tasks | consume_tasks, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                if task in read_tasks:
+                    read_tasks.discard(task)
+                    pipeline = task.result()
+                    consume_tasks.add(
+                        asyncio.ensure_future(pipeline.consume(executor))
+                    )
+                elif task in consume_tasks:
+                    consume_tasks.discard(task)
+                    pipeline = task.result()
+                    budget += pipeline.consuming_cost
+                    reporter.report_request_done(pipeline.consuming_cost)
+            dispatch_reads()
+    finally:
+        executor.shutdown(wait=True)
+    reporter.summarize()
+
+
+def sync_execute_read_reqs(
+    read_reqs: List[ReadReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+    event_loop: asyncio.AbstractEventLoop,
+) -> None:
+    event_loop.run_until_complete(
+        execute_read_reqs(read_reqs, storage, memory_budget_bytes, rank)
+    )

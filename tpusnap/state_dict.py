@@ -1,0 +1,16 @@
+"""StateDict — a dict that satisfies the Stateful protocol, for tracking
+plain values (progress counters, hyperparameters, metrics) in app state.
+
+Counterpart of /root/reference/torchsnapshot/state_dict.py:13.
+"""
+
+from typing import Any, Dict
+
+
+class StateDict(Dict[str, Any]):
+    def state_dict(self) -> Dict[str, Any]:
+        return self
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        self.clear()
+        self.update(state_dict)

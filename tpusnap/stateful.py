@@ -1,0 +1,20 @@
+"""The Stateful protocol: anything with state_dict()/load_state_dict().
+
+Counterpart of /root/reference/torchsnapshot/stateful.py:13-23. In JAX
+there are no nn.Modules carrying state — app state is explicit pytrees —
+so the protocol is the same but the canonical implementations are
+``StateDict`` (plain dict) and ``PytreeState`` (arbitrary pytree with
+structure-preserving load).
+"""
+
+from typing import Any, Dict, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Stateful(Protocol):
+    def state_dict(self) -> Dict[str, Any]: ...
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None: ...
+
+
+AppState = Dict[str, Stateful]

@@ -1,0 +1,67 @@
+"""URL scheme → StoragePlugin registry.
+
+Counterpart of /root/reference/torchsnapshot/storage_plugin.py:18-70.
+Built-ins: fs (default), s3, gs/gcs, and a generic fsspec bridge
+(``fsspec+<protocol>://``). Third-party plugins register through the
+``tpusnap.storage_plugins`` entry-point group.
+"""
+
+import asyncio
+from importlib.metadata import entry_points
+from typing import Any, Dict, Optional
+
+from .io_types import StoragePlugin
+
+_ENTRY_POINT_GROUP = "tpusnap.storage_plugins"
+
+
+def url_to_storage_plugin(
+    url_path: str, storage_options: Optional[Dict[str, Any]] = None
+) -> StoragePlugin:
+    """Map ``[scheme://]path`` to a storage plugin instance."""
+    if "://" in url_path:
+        scheme, path = url_path.split("://", 1)
+    else:
+        scheme, path = "fs", url_path
+    scheme = scheme.lower()
+
+    if scheme in ("", "fs", "file"):
+        from .storage_plugins.fs import FSStoragePlugin
+
+        return FSStoragePlugin(root=path, storage_options=storage_options)
+    if scheme == "s3":
+        from .storage_plugins.s3 import S3StoragePlugin
+
+        return S3StoragePlugin(root=path, storage_options=storage_options)
+    if scheme in ("gs", "gcs"):
+        from .storage_plugins.gcs import GCSStoragePlugin
+
+        return GCSStoragePlugin(root=path, storage_options=storage_options)
+    if scheme.startswith("fsspec+"):
+        from .storage_plugins.fsspec import FsspecStoragePlugin
+
+        return FsspecStoragePlugin(
+            protocol=scheme[len("fsspec+") :],
+            root=path,
+            storage_options=storage_options,
+        )
+
+    # Third-party plugins via entry points (reference storage_plugin.py:53-65).
+    eps = entry_points()
+    group = eps.select(group=_ENTRY_POINT_GROUP) if hasattr(eps, "select") else []
+    for ep in group:
+        if ep.name == scheme:
+            factory = ep.load()
+            return factory(path, storage_options)
+    raise RuntimeError(f"Unsupported storage scheme: {scheme}:// ({url_path})")
+
+
+def url_to_storage_plugin_in_event_loop(
+    url_path: str,
+    event_loop: asyncio.AbstractEventLoop,
+    storage_options: Optional[Dict[str, Any]] = None,
+) -> StoragePlugin:
+    async def _create() -> StoragePlugin:
+        return url_to_storage_plugin(url_path, storage_options)
+
+    return event_loop.run_until_complete(_create())

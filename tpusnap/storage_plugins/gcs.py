@@ -1,0 +1,214 @@
+"""GCS storage plugin — the north-star cloud target.
+
+Counterpart of /root/reference/torchsnapshot/storage_plugins/gcs.py:
+hand-rolled resumable uploads and chunked (100MB) ranged downloads over an
+``AuthorizedSession``, run in a thread-pool executor so many transfers
+proceed concurrently under asyncio; transient-error classification
+(gcs.py:89-109) and the collective-progress retry strategy (gcs.py:216-272):
+instead of a fixed per-request retry budget, a shared deadline is refreshed
+whenever *any* concurrent transfer makes progress — so a pod-wide slowdown
+doesn't abort the snapshot while the storage backend is merely saturated,
+but a genuinely wedged backend still times out.
+"""
+
+import asyncio
+import io
+import logging
+import os
+import random
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+logger = logging.getLogger(__name__)
+
+_UPLOAD_CHUNK_SIZE = 100 * 1024 * 1024
+_DOWNLOAD_CHUNK_SIZE = 100 * 1024 * 1024
+_TRANSIENT_STATUS = {408, 429, 500, 502, 503, 504}
+_DEFAULT_DEADLINE_SEC = 600
+
+
+def _is_transient(exc: Exception) -> bool:
+    status = getattr(getattr(exc, "response", None), "status_code", None)
+    if status in _TRANSIENT_STATUS:
+        return True
+    # connection-level failures are transient
+    import requests
+
+    return isinstance(
+        exc, (requests.ConnectionError, requests.Timeout, ConnectionError, TimeoutError)
+    )
+
+
+class _RetryStrategy:
+    """Collective-progress retry: a shared deadline, refreshed whenever any
+    concurrent coroutine completes a transfer (reference gcs.py:216-272)."""
+
+    def __init__(self, deadline_sec: float = _DEFAULT_DEADLINE_SEC) -> None:
+        self._deadline_sec = deadline_sec
+        self._deadline = time.monotonic() + deadline_sec
+
+    def report_progress(self) -> None:
+        self._deadline = time.monotonic() + self._deadline_sec
+
+    def expired(self) -> bool:
+        return time.monotonic() > self._deadline
+
+    async def backoff(self, attempt: int) -> None:
+        await asyncio.sleep(min(2**attempt, 30) * (0.5 + random.random()))
+
+
+class GCSStoragePlugin(StoragePlugin):
+    def __init__(
+        self, root: str, storage_options: Optional[Dict[str, Any]] = None
+    ) -> None:
+        try:
+            import google.auth
+            from google.auth.transport.requests import AuthorizedSession
+        except ImportError as e:
+            raise RuntimeError(
+                "GCS support requires google-auth (pip install google-auth)"
+            ) from e
+        components = root.split("/", 1)
+        if len(components) != 2 or not components[0]:
+            raise ValueError(f"Invalid gcs root: {root!r} (expected gs://bucket/prefix)")
+        self.bucket, self.root = components[0], components[1]
+        storage_options = storage_options or {}
+        scopes = ["https://www.googleapis.com/auth/devstorage.read_write"]
+        credentials, _ = google.auth.default(scopes=scopes)
+        self._session = AuthorizedSession(credentials)
+        self._executor = ThreadPoolExecutor(
+            max_workers=int(storage_options.get("max_workers", 16)),
+            thread_name_prefix="tpusnap-gcs",
+        )
+        self._retry = _RetryStrategy(
+            float(storage_options.get("deadline_sec", _DEFAULT_DEADLINE_SEC))
+        )
+
+    def _object_name(self, path: str) -> str:
+        return f"{self.root}/{path}"
+
+    # --- blocking primitives, run in the executor ------------------------
+
+    def _initiate_resumable_upload(self, name: str) -> str:
+        from urllib.parse import quote
+
+        url = (
+            f"https://storage.googleapis.com/upload/storage/v1/b/{self.bucket}/o"
+            f"?uploadType=resumable&name={quote(name, safe='')}"
+        )
+        resp = self._session.post(url, json={})
+        resp.raise_for_status()
+        return resp.headers["Location"]
+
+    def _upload_chunk(
+        self, session_url: str, chunk: memoryview, offset: int, total: int
+    ) -> None:
+        end = offset + len(chunk)
+        headers = {
+            "Content-Length": str(len(chunk)),
+            "Content-Range": f"bytes {offset}-{end - 1}/{total}",
+        }
+        resp = self._session.put(session_url, data=bytes(chunk), headers=headers)
+        # 308 = resume incomplete (expected mid-stream); 2xx on final chunk.
+        if resp.status_code not in (200, 201, 308):
+            resp.raise_for_status()
+
+    def _upload_empty(self, name: str) -> None:
+        from urllib.parse import quote
+
+        url = (
+            f"https://storage.googleapis.com/upload/storage/v1/b/{self.bucket}/o"
+            f"?uploadType=media&name={quote(name, safe='')}"
+        )
+        resp = self._session.post(url, data=b"")
+        resp.raise_for_status()
+
+    def _download_range(self, name: str, start: int, end: int) -> bytes:
+        from urllib.parse import quote
+
+        url = (
+            f"https://storage.googleapis.com/storage/v1/b/{self.bucket}"
+            f"/o/{quote(name, safe='')}?alt=media"
+        )
+        headers = {"Range": f"bytes={start}-{end - 1}"}
+        resp = self._session.get(url, headers=headers)
+        resp.raise_for_status()
+        return resp.content
+
+    def _object_size(self, name: str) -> int:
+        from urllib.parse import quote
+
+        url = (
+            f"https://storage.googleapis.com/storage/v1/b/{self.bucket}"
+            f"/o/{quote(name, safe='')}"
+        )
+        resp = self._session.get(url)
+        resp.raise_for_status()
+        return int(resp.json()["size"])
+
+    def _delete_blocking(self, name: str) -> None:
+        from urllib.parse import quote
+
+        url = (
+            f"https://storage.googleapis.com/storage/v1/b/{self.bucket}"
+            f"/o/{quote(name, safe='')}"
+        )
+        resp = self._session.delete(url)
+        resp.raise_for_status()
+
+    # --- retry wrapper ---------------------------------------------------
+
+    async def _with_retry(self, fn, *args):
+        loop = asyncio.get_running_loop()
+        attempt = 0
+        while True:
+            try:
+                result = await loop.run_in_executor(self._executor, fn, *args)
+                self._retry.report_progress()
+                return result
+            except Exception as e:
+                if not _is_transient(e) or self._retry.expired():
+                    raise
+                attempt += 1
+                logger.warning(
+                    "Transient GCS error (attempt %d): %s; retrying", attempt, e
+                )
+                await self._retry.backoff(attempt)
+
+    # --- plugin interface ------------------------------------------------
+
+    async def write(self, write_io: WriteIO) -> None:
+        name = self._object_name(write_io.path)
+        buf = memoryview(write_io.buf).cast("B")
+        total = buf.nbytes
+        if total == 0:
+            await self._with_retry(self._upload_empty, name)
+            return
+        session_url = await self._with_retry(self._initiate_resumable_upload, name)
+        for offset in range(0, total, _UPLOAD_CHUNK_SIZE):
+            chunk = buf[offset : offset + _UPLOAD_CHUNK_SIZE]
+            await self._with_retry(
+                self._upload_chunk, session_url, chunk, offset, total
+            )
+
+    async def read(self, read_io: ReadIO) -> None:
+        name = self._object_name(read_io.path)
+        if read_io.byte_range is not None:
+            start, end = read_io.byte_range
+        else:
+            start, end = 0, await self._with_retry(self._object_size, name)
+        out = io.BytesIO()
+        for offset in range(start, end, _DOWNLOAD_CHUNK_SIZE):
+            chunk_end = min(offset + _DOWNLOAD_CHUNK_SIZE, end)
+            out.write(await self._with_retry(self._download_range, name, offset, chunk_end))
+        out.seek(0)
+        read_io.buf = out
+
+    async def delete(self, path: str) -> None:
+        await self._with_retry(self._delete_blocking, self._object_name(path))
+
+    async def close(self) -> None:
+        self._executor.shutdown(wait=True)

@@ -1,0 +1,78 @@
+"""S3 storage plugin (counterpart of
+/root/reference/torchsnapshot/storage_plugins/s3.py:39-66).
+
+Uses aiobotocore when installed; ranged reads via the HTTP Range header.
+Import of aiobotocore is deferred to construction so environments without
+it can still use every other plugin.
+"""
+
+import io
+from typing import Any, Dict, Optional
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..memoryview_stream import MemoryviewStream
+
+
+class S3StoragePlugin(StoragePlugin):
+    def __init__(
+        self, root: str, storage_options: Optional[Dict[str, Any]] = None
+    ) -> None:
+        try:
+            from aiobotocore.session import get_session
+        except ImportError as e:
+            raise RuntimeError(
+                "S3 support requires aiobotocore (pip install aiobotocore)"
+            ) from e
+        components = root.split("/", 1)
+        if len(components) != 2 or not components[0]:
+            raise ValueError(
+                f"Invalid s3 root: {root!r} (expected s3://bucket/prefix)"
+            )
+        self.bucket, self.root = components[0], components[1]
+        self.session = get_session()
+        self._client = None
+        self._client_ctx = None
+        self._storage_options = storage_options or {}
+
+    async def _get_client(self):
+        if self._client is None:
+            self._client_ctx = self.session.create_client(
+                "s3", **self._storage_options.get("client_kwargs", {})
+            )
+            self._client = await self._client_ctx.__aenter__()
+        return self._client
+
+    def _key(self, path: str) -> str:
+        return f"{self.root}/{path}"
+
+    async def write(self, write_io: WriteIO) -> None:
+        client = await self._get_client()
+        buf = write_io.buf
+        body = MemoryviewStream(buf) if isinstance(buf, memoryview) else io.BytesIO(buf)
+        await client.put_object(
+            Bucket=self.bucket, Key=self._key(write_io.path), Body=body
+        )
+
+    async def read(self, read_io: ReadIO) -> None:
+        client = await self._get_client()
+        kwargs: Dict[str, Any] = {
+            "Bucket": self.bucket,
+            "Key": self._key(read_io.path),
+        }
+        if read_io.byte_range is not None:
+            start, end = read_io.byte_range
+            # HTTP Range is inclusive on both ends.
+            kwargs["Range"] = f"bytes={start}-{end - 1}"
+        response = await client.get_object(**kwargs)
+        async with response["Body"] as stream:
+            read_io.buf = io.BytesIO(await stream.read())
+
+    async def delete(self, path: str) -> None:
+        client = await self._get_client()
+        await client.delete_object(Bucket=self.bucket, Key=self._key(path))
+
+    async def close(self) -> None:
+        if self._client_ctx is not None:
+            await self._client_ctx.__aexit__(None, None, None)
+            self._client = None
+            self._client_ctx = None
