@@ -1,16 +1,26 @@
 """Test configuration: force an 8-device CPU platform so sharding tests can
 exercise real multi-device meshes without TPU hardware (the driver dry-runs
-the multi-chip path the same way)."""
+the multi-chip path the same way).
+
+The environment pre-sets PYTHONPATH=/root/.axon_site whose sitecustomize
+registers the real-TPU "axon" backend at interpreter startup, so plain
+JAX_PLATFORMS env assignment is too late — but jax.config.update still
+works as long as no devices have been queried yet.
+"""
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

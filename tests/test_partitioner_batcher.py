@@ -1,0 +1,157 @@
+"""Partitioner + batcher unit tests (reference tests/test_partitioner.py,
+tests/test_batcher.py patterns, without multi-process)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from tpusnap.batcher import batch_read_requests, batch_write_requests
+from tpusnap.io_preparers.array import ArrayBufferStager, ArrayIOPreparer
+from tpusnap.io_types import BufferConsumer, ReadReq, WriteReq
+from tpusnap.knobs import override_slab_size_threshold_bytes
+from tpusnap.manifest import TensorEntry
+from tpusnap.partitioner import (
+    _greedy_assign,
+    consolidate_replicated_entries,
+)
+
+
+def _tensor_entry(path, nbytes=100, replicated=True, location=None):
+    return TensorEntry(
+        location=location or f"replicated/{path}",
+        serializer="buffer_protocol",
+        dtype="uint8",
+        shape=[nbytes],
+        replicated=replicated,
+    )
+
+
+def test_greedy_assignment_balances():
+    units = [(f"u{i}", [f"p{i}"], size) for i, size in enumerate([100, 90, 50, 40, 30, 10])]
+    assignment = _greedy_assign(units, [0, 0, 0])
+    loads = [0, 0, 0]
+    for (key, _, size) in units:
+        loads[assignment[key]] += size
+    assert max(loads) - min(loads) <= 40  # largest-first greedy is balanced
+    assert set(assignment.values()) == {0, 1, 2}
+
+
+def test_greedy_respects_preexisting_load():
+    units = [("u", ["p"], 10)]
+    assignment = _greedy_assign(units, [1000, 0])
+    assert assignment["u"] == 1
+
+
+def test_consolidate_prefers_writer_batched_version():
+    """The writer rank's slab-batched entry (location under batched/) must
+    win over rank 0's unbatched copy — otherwise the manifest points at a
+    blob nobody wrote (code-review regression)."""
+    rank0 = {"m/w": _tensor_entry("m/w")}
+    rank1_entry = _tensor_entry("m/w", location="batched/abc123")
+    rank1_entry.byte_range = [0, 100]
+    rank1 = {"m/w": rank1_entry}
+    merged = consolidate_replicated_entries([rank0, rank1])
+    assert merged["0/m/w"].location == "batched/abc123"
+    assert merged["0/m/w"].byte_range == [0, 100]
+    assert "1/m/w" not in merged
+
+
+def test_consolidate_keeps_per_rank_entries():
+    rank0 = {"m/x": _tensor_entry("m/x", replicated=False, location="0/m/x")}
+    rank1 = {"m/x": _tensor_entry("m/x", replicated=False, location="1/m/x")}
+    merged = consolidate_replicated_entries([rank0, rank1])
+    assert merged["0/m/x"].location == "0/m/x"
+    assert merged["1/m/x"].location == "1/m/x"
+
+
+def test_batch_write_requests_packs_slabs(tmp_path):
+    arrays = {f"a{i}": np.full(100, i, dtype=np.uint8) for i in range(10)}
+    entries = {}
+    write_reqs = []
+    for name, arr in arrays.items():
+        entry, reqs = ArrayIOPreparer.prepare_write(f"0/{name}", arr)
+        entries[name] = entry
+        write_reqs += reqs
+    entries_list, reqs = batch_write_requests(list(entries.values()), write_reqs)
+    assert len(reqs) == 1  # all ten 100B writes in one slab
+    slab_req = reqs[0]
+    assert slab_req.path.startswith("batched/")
+    for entry in entries.values():
+        assert entry.location == slab_req.path
+        assert entry.byte_range is not None
+
+    # stage the slab and check each member's byte range holds its data
+    buf = asyncio.run(slab_req.buffer_stager.stage_buffer())
+    mv = memoryview(buf)
+    for name, arr in arrays.items():
+        start, end = entries[name].byte_range
+        assert bytes(mv[start:end]) == arr.tobytes()
+
+
+def test_batch_write_respects_threshold():
+    with override_slab_size_threshold_bytes(250):
+        arrays = {f"a{i}": np.full(100, i, dtype=np.uint8) for i in range(5)}
+        entries, write_reqs = {}, []
+        for name, arr in arrays.items():
+            entry, reqs = ArrayIOPreparer.prepare_write(f"0/{name}", arr)
+            entries[name] = entry
+            write_reqs += reqs
+        _, reqs = batch_write_requests(list(entries.values()), write_reqs)
+        # 5×100B with 250B slabs → 3 slabs (2+2+1); the singleton stays raw
+        slab_reqs = [r for r in reqs if r.path.startswith("batched/")]
+        assert len(slab_reqs) == 2
+        assert len(reqs) == 3
+
+
+class _CollectConsumer(BufferConsumer):
+    def __init__(self, sink, key):
+        self.sink, self.key = sink, key
+
+    async def consume_buffer(self, buf, executor=None):
+        self.sink[self.key] = bytes(buf)
+
+    def get_consuming_cost_bytes(self):
+        return 1
+
+
+def test_batch_read_requests_merges_spans():
+    sink = {}
+    reqs = [
+        ReadReq("loc", _CollectConsumer(sink, "a"), byte_range=(0, 10)),
+        ReadReq("loc", _CollectConsumer(sink, "b"), byte_range=(10, 20)),
+        ReadReq("loc", _CollectConsumer(sink, "c"), byte_range=(20, 32)),
+        ReadReq("other", _CollectConsumer(sink, "d"), byte_range=(5, 9)),
+    ]
+    merged = batch_read_requests(reqs)
+    assert len(merged) == 2
+    span = [r for r in merged if r.path == "loc"][0]
+    assert span.byte_range == (0, 32)
+    data = bytes(range(32))
+    asyncio.run(span.buffer_consumer.consume_buffer(data))
+    assert sink["a"] == data[0:10] and sink["b"] == data[10:20] and sink["c"] == data[20:32]
+
+
+def test_batch_read_skips_sparse_spans():
+    sink = {}
+    reqs = [
+        ReadReq("loc", _CollectConsumer(sink, "a"), byte_range=(0, 10)),
+        ReadReq("loc", _CollectConsumer(sink, "b"), byte_range=(1000, 1010)),
+    ]
+    merged = batch_read_requests(reqs)
+    assert len(merged) == 2  # too sparse to merge
+
+
+def test_batching_disabled_knob():
+    from tpusnap.knobs import override_batching_disabled
+
+    arrays = {f"a{i}": np.full(100, i, dtype=np.uint8) for i in range(4)}
+    entries, write_reqs = {}, []
+    for name, arr in arrays.items():
+        entry, reqs = ArrayIOPreparer.prepare_write(f"0/{name}", arr)
+        entries[name] = entry
+        write_reqs += reqs
+    with override_batching_disabled(True):
+        _, reqs = batch_write_requests(list(entries.values()), write_reqs)
+        assert len(reqs) == 4

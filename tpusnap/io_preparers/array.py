@@ -86,8 +86,13 @@ class ArrayBufferStager(BufferStager):
         mv = array_as_memoryview(host)
         if self.is_async_snapshot and _may_alias_live_memory(self.arr, host):
             # Defensive clone: training resumes before I/O completes, and a
-            # donated buffer could be overwritten under us.
-            return bytearray(mv)
+            # donated buffer could be overwritten under us. The native
+            # memcpy releases the GIL (and parallelizes) for large clones.
+            from .. import _native
+
+            out = bytearray(mv.nbytes)
+            _native.memcpy(out, mv)
+            return out
         return mv
 
     def get_staging_cost_bytes(self) -> int:
@@ -276,11 +281,9 @@ class _TileConsumer(BufferConsumer):
             await loop.run_in_executor(executor, self._consume_blocking, buf)
         else:
             self._consume_blocking(buf)
-
-    def _consume_blocking(self, buf: BufferType) -> None:
-        tile_shape = [self.r1 - self.r0] + list(self.entry.shape[1:])
-        src = array_from_memoryview(memoryview(buf), self.entry.dtype, tile_shape)
-        np.copyto(self.host_out[self.r0 : self.r1], src)
+        # Completion bookkeeping stays on the event-loop thread — the
+        # executor runs up to 4 consumers concurrently and a bare
+        # read-modify-write there can lose decrements.
         self.remaining["count"] -= 1
         if self.remaining["count"] == 0:
             if self.in_place:
@@ -289,6 +292,11 @@ class _TileConsumer(BufferConsumer):
                 self.fut.obj = jax.device_put(self.host_out, self.obj_out.sharding)
             else:
                 self.fut.obj = self.host_out
+
+    def _consume_blocking(self, buf: BufferType) -> None:
+        tile_shape = [self.r1 - self.r0] + list(self.entry.shape[1:])
+        src = array_from_memoryview(memoryview(buf), self.entry.dtype, tile_shape)
+        np.copyto(self.host_out[self.r0 : self.r1], src)
 
     def get_consuming_cost_bytes(self) -> int:
         return tensor_nbytes(

@@ -1,14 +1,17 @@
 """Fallback preparer for arbitrary picklable objects.
 
 Counterpart of /root/reference/torchsnapshot/io_preparers/object.py
-(which uses torch.save — also pickle underneath). Costs are approximated
-with sys.getsizeof before serialization, as in the reference (:76-78).
+(which uses torch.save — also pickle underneath). Unlike the reference
+(which estimates costs with sys.getsizeof, :76-78), objects are pickled
+eagerly at prepare time: they are small in practice (configs, schedules,
+metrics), this freezes their content for async snapshots, and it makes
+both the staging cost and the manifest ``nbytes`` exact — which the read
+scheduler's memory budget relies on.
 """
 
 from __future__ import annotations
 
 import asyncio
-import sys
 from concurrent.futures import Executor
 from typing import Any, List, Optional, Tuple
 
@@ -25,29 +28,26 @@ from ..serialization import Serializer, pickle_as_bytes, pickle_from_bytes
 
 
 class ObjectBufferStager(BufferStager):
-    def __init__(self, obj: Any) -> None:
-        self.obj = obj
+    def __init__(self, buf: bytes) -> None:
+        self.buf = buf
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
-        loop = asyncio.get_running_loop()
-        if executor is not None:
-            return await loop.run_in_executor(executor, pickle_as_bytes, self.obj)
-        return pickle_as_bytes(self.obj)
+        return self.buf
 
     def get_staging_cost_bytes(self) -> int:
-        return sys.getsizeof(self.obj)
+        return len(self.buf)
 
 
 class ObjectBufferConsumer(BufferConsumer):
-    def __init__(self, fut: Future) -> None:
+    def __init__(self, fut: Future, nbytes: int) -> None:
         self.fut = fut
-        self._estimated_cost = 0
+        self.nbytes = nbytes
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
-        loop = asyncio.get_running_loop()
         if executor is not None:
+            loop = asyncio.get_running_loop()
             self.fut.obj = await loop.run_in_executor(
                 executor, pickle_from_bytes, bytes(buf)
             )
@@ -55,7 +55,7 @@ class ObjectBufferConsumer(BufferConsumer):
             self.fut.obj = pickle_from_bytes(bytes(buf))
 
     def get_consuming_cost_bytes(self) -> int:
-        return max(self._estimated_cost, 1)
+        return max(self.nbytes, 1)
 
 
 class ObjectIOPreparer:
@@ -63,19 +63,18 @@ class ObjectIOPreparer:
     def prepare_write(
         storage_path: str, obj: Any, replicated: bool = False
     ) -> Tuple[ObjectEntry, List[WriteReq]]:
+        buf = pickle_as_bytes(obj)
         entry = ObjectEntry(
             location=storage_path,
             serializer=Serializer.PICKLE.value,
             obj_type=type(obj).__name__,
             replicated=replicated,
+            nbytes=len(buf),
         )
-        return entry, [
-            WriteReq(path=storage_path, buffer_stager=ObjectBufferStager(obj))
-        ]
+        return entry, [WriteReq(path=storage_path, buffer_stager=ObjectBufferStager(buf))]
 
     @staticmethod
     def prepare_read(entry: ObjectEntry) -> Tuple[List[ReadReq], Future]:
         fut: Future = Future()
-        return [
-            ReadReq(path=entry.location, buffer_consumer=ObjectBufferConsumer(fut))
-        ], fut
+        consumer = ObjectBufferConsumer(fut, nbytes=entry.nbytes or 0)
+        return [ReadReq(path=entry.location, buffer_consumer=consumer)], fut

@@ -175,15 +175,22 @@ class ObjectEntry(Entry):
     serializer: str
     obj_type: str
     replicated: bool
+    nbytes: Optional[int] = None  # serialized size; drives read memory budget
 
     def __init__(
-        self, location: str, serializer: str, obj_type: str, replicated: bool
+        self,
+        location: str,
+        serializer: str,
+        obj_type: str,
+        replicated: bool,
+        nbytes: Optional[int] = None,
     ) -> None:
         super().__init__(type="object")
         self.location = location
         self.serializer = serializer
         self.obj_type = obj_type
         self.replicated = replicated
+        self.nbytes = nbytes
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ObjectEntry":
@@ -192,6 +199,7 @@ class ObjectEntry(Entry):
             serializer=d["serializer"],
             obj_type=d["obj_type"],
             replicated=d["replicated"],
+            nbytes=d.get("nbytes"),
         )
 
 

@@ -111,16 +111,36 @@ class _Reporter:
 @dataclass
 class PendingIOWork:
     """Residual storage I/O after staging completed (reference
-    scheduler.py:178-217)."""
+    scheduler.py:178-217). Keeps honoring the I/O concurrency cap while
+    draining."""
 
     io_tasks: Set[asyncio.Task] = field(default_factory=set)
+    pending_pipelines: List["_WritePipeline"] = field(default_factory=list)
     executor: Optional[ThreadPoolExecutor] = None
     reporter: Optional[_Reporter] = None
 
     async def complete(self) -> None:
+        io_tasks = set(self.io_tasks)
         try:
-            if self.io_tasks:
-                await asyncio.gather(*self.io_tasks)
+            pending = list(self.pending_pipelines)
+            while io_tasks or pending:
+                while pending and len(io_tasks) < _MAX_IO_CONCURRENCY:
+                    io_tasks.add(asyncio.ensure_future(pending.pop(0).write()))
+                done, io_tasks = await asyncio.wait(
+                    io_tasks, return_when=asyncio.FIRST_COMPLETED
+                )
+                for task in done:
+                    pipeline = task.result()
+                    if self.reporter is not None:
+                        self.reporter.report_request_done(pipeline.buf_size)
+        except BaseException:
+            # One write failed: cancel and await the siblings so the event
+            # loop can be closed cleanly and no write keeps running into
+            # the aborted snapshot directory.
+            for task in io_tasks:
+                task.cancel()
+            await asyncio.gather(*io_tasks, return_exceptions=True)
+            raise
         finally:
             if self.executor is not None:
                 self.executor.shutdown(wait=True)
@@ -189,37 +209,46 @@ async def execute_write_reqs(
             io_tasks.add(asyncio.ensure_future(ready.pop(0).write()))
 
     ready_for_io: List[_WritePipeline] = []
-    dispatch_staging()
-    while staging_tasks or pipelines:
-        done, _ = await asyncio.wait(
-            staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
-        )
-        for task in done:
-            if task in staging_tasks:
-                staging_tasks.discard(task)
-                pipeline = task.result()  # re-raises staging failure
-                # Staged buffer may be smaller than the staging cost
-                # (e.g. cost model overestimates); credit the difference.
-                budget += pipeline.staging_cost - pipeline.buf_size
-                ready_for_io.append(pipeline)
-            elif task in io_tasks:
-                io_tasks.discard(task)
-                pipeline = task.result()
-                budget += pipeline.buf_size
-                reporter.report_request_done(pipeline.buf_size)
-        dispatch_io(ready_for_io)
+    try:
         dispatch_staging()
+        while staging_tasks or pipelines:
+            done, _ = await asyncio.wait(
+                staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                if task in staging_tasks:
+                    staging_tasks.discard(task)
+                    pipeline = task.result()  # re-raises staging failure
+                    # Staged buffer may be smaller than the staging cost
+                    # (e.g. cost model overestimates); credit the difference.
+                    budget += pipeline.staging_cost - pipeline.buf_size
+                    ready_for_io.append(pipeline)
+                elif task in io_tasks:
+                    io_tasks.discard(task)
+                    pipeline = task.result()
+                    budget += pipeline.buf_size
+                    reporter.report_request_done(pipeline.buf_size)
+            dispatch_io(ready_for_io)
+            dispatch_staging()
+    except BaseException:
+        # Abort cleanly: cancel in-flight work and release the executor so
+        # a failed take() doesn't leak threads or keep writing into the
+        # half-aborted snapshot directory.
+        for task in staging_tasks | io_tasks:
+            task.cancel()
+        await asyncio.gather(*(staging_tasks | io_tasks), return_exceptions=True)
+        executor.shutdown(wait=True)
+        raise
 
     # Staging complete: snapshot content is now frozen. Remaining I/O is
     # handed back so the caller decides whether to drain it in the
     # foreground (take) or a background thread (async_take).
-    async def _drain_rest(pipeline: _WritePipeline) -> None:
-        await pipeline.write()
-        reporter.report_request_done(pipeline.buf_size)
-
-    for pipeline in ready_for_io:
-        io_tasks.add(asyncio.ensure_future(_drain_rest(pipeline)))
-    return PendingIOWork(io_tasks=io_tasks, executor=executor, reporter=reporter)
+    return PendingIOWork(
+        io_tasks=io_tasks,
+        pending_pipelines=ready_for_io,
+        executor=executor,
+        reporter=reporter,
+    )
 
 
 def sync_execute_write_reqs(

@@ -440,19 +440,16 @@ def _take_impl(
 
 def _gather_manifest(entries: Manifest, comm: Communicator) -> Manifest:
     """All-gather per-rank manifests; key by ``rank/logical_path``;
-    consolidate replicated entries onto rank 0 (reference :842-853,
+    consolidate replicated entries onto rank 0, preferring the writer's
+    (possibly slab-batched) entry version (reference :842-853,
     partitioner.py:262-303)."""
+    from .partitioner import consolidate_replicated_entries
+
     if comm.world_size == 1:
         per_rank = [entries]
     else:
         per_rank = comm.all_gather_object(entries)
-    global_manifest: Manifest = {}
-    for r, rank_entries in enumerate(per_rank):
-        for logical_path, entry in rank_entries.items():
-            if r != 0 and is_replicated(entry):
-                continue  # deduped onto rank 0
-            global_manifest[f"{r}/{logical_path}"] = entry
-    return global_manifest
+    return consolidate_replicated_entries(per_rank)
 
 
 def _write_metadata(

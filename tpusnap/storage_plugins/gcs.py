@@ -116,6 +116,24 @@ class GCSStoragePlugin(StoragePlugin):
         if resp.status_code not in (200, 201, 308):
             resp.raise_for_status()
 
+    def _query_persisted_offset(self, session_url: str, total: int) -> int:
+        """Ask the resumable session how many bytes it has durably stored
+        (the protocol-mandated status check after an interrupted chunk:
+        PUT with ``Content-Range: bytes */total``)."""
+        resp = self._session.put(
+            session_url,
+            headers={"Content-Range": f"bytes */{total}", "Content-Length": "0"},
+        )
+        if resp.status_code in (200, 201):
+            return total  # upload actually completed
+        if resp.status_code == 308:
+            persisted = resp.headers.get("Range")  # e.g. "bytes=0-524287"
+            if persisted is None:
+                return 0
+            return int(persisted.rsplit("-", 1)[1]) + 1
+        resp.raise_for_status()
+        return 0
+
     def _upload_empty(self, name: str) -> None:
         from urllib.parse import quote
 
@@ -161,22 +179,28 @@ class GCSStoragePlugin(StoragePlugin):
 
     # --- retry wrapper ---------------------------------------------------
 
-    async def _with_retry(self, fn, *args):
+    async def _retry_gate(self, e: Exception, attempt: int) -> None:
+        """Shared transient-or-raise + backoff step for all retry loops."""
+        if not _is_transient(e) or self._retry.expired():
+            raise e
+        logger.warning("Transient GCS error (attempt %d): %s; retrying", attempt, e)
+        await self._retry.backoff(attempt)
+
+    async def _with_retry(self, fn, *args, counts_as_progress: bool = True):
         loop = asyncio.get_running_loop()
         attempt = 0
         while True:
             try:
                 result = await loop.run_in_executor(self._executor, fn, *args)
-                self._retry.report_progress()
+                if counts_as_progress:
+                    # Only data-carrying operations refresh the collective
+                    # deadline; cheap status probes succeeding must not keep
+                    # a wedged upload alive forever.
+                    self._retry.report_progress()
                 return result
             except Exception as e:
-                if not _is_transient(e) or self._retry.expired():
-                    raise
                 attempt += 1
-                logger.warning(
-                    "Transient GCS error (attempt %d): %s; retrying", attempt, e
-                )
-                await self._retry.backoff(attempt)
+                await self._retry_gate(e, attempt)
 
     # --- plugin interface ------------------------------------------------
 
@@ -188,11 +212,31 @@ class GCSStoragePlugin(StoragePlugin):
             await self._with_retry(self._upload_empty, name)
             return
         session_url = await self._with_retry(self._initiate_resumable_upload, name)
-        for offset in range(0, total, _UPLOAD_CHUNK_SIZE):
+        loop = asyncio.get_running_loop()
+        offset = 0
+        attempt = 0
+        while offset < total:
             chunk = buf[offset : offset + _UPLOAD_CHUNK_SIZE]
-            await self._with_retry(
-                self._upload_chunk, session_url, chunk, offset, total
-            )
+            try:
+                await loop.run_in_executor(
+                    self._executor, self._upload_chunk, session_url, chunk, offset, total
+                )
+                self._retry.report_progress()
+                offset += len(chunk)
+                attempt = 0
+            except Exception as e:
+                attempt += 1
+                await self._retry_gate(e, attempt)
+                # A partially-persisted chunk moves the session's write
+                # head; blindly re-PUTting the old Content-Range would be
+                # rejected as an offset mismatch. Resynchronize first (a
+                # status probe — must not refresh the progress deadline).
+                offset = await self._with_retry(
+                    self._query_persisted_offset,
+                    session_url,
+                    total,
+                    counts_as_progress=False,
+                )
 
     async def read(self, read_io: ReadIO) -> None:
         name = self._object_name(read_io.path)
