@@ -64,6 +64,16 @@ def get_process_memory_budget_bytes(comm=None) -> int:
     return min(budget, _MAX_PER_RANK_MEMORY_BUDGET_BYTES)
 
 
+async def _cancel_and_drain(tasks: Set[asyncio.Task]) -> None:
+    """Abort helper shared by the write loop and PendingIOWork: cancel
+    in-flight tasks and await them so the loop can close cleanly and no
+    write keeps running into an aborted snapshot directory."""
+    for task in tasks:
+        task.cancel()
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
 class _Reporter:
     """Periodic pipeline progress logging (reference scheduler.py:96-175)."""
 
@@ -134,12 +144,7 @@ class PendingIOWork:
                     if self.reporter is not None:
                         self.reporter.report_request_done(pipeline.buf_size)
         except BaseException:
-            # One write failed: cancel and await the siblings so the event
-            # loop can be closed cleanly and no write keeps running into
-            # the aborted snapshot directory.
-            for task in io_tasks:
-                task.cancel()
-            await asyncio.gather(*io_tasks, return_exceptions=True)
+            await _cancel_and_drain(io_tasks)
             raise
         finally:
             if self.executor is not None:
@@ -231,12 +236,7 @@ async def execute_write_reqs(
             dispatch_io(ready_for_io)
             dispatch_staging()
     except BaseException:
-        # Abort cleanly: cancel in-flight work and release the executor so
-        # a failed take() doesn't leak threads or keep writing into the
-        # half-aborted snapshot directory.
-        for task in staging_tasks | io_tasks:
-            task.cancel()
-        await asyncio.gather(*(staging_tasks | io_tasks), return_exceptions=True)
+        await _cancel_and_drain(staging_tasks | io_tasks)
         executor.shutdown(wait=True)
         raise
 

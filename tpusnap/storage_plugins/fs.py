@@ -57,37 +57,38 @@ class FSStoragePlugin(StoragePlugin):
 
     async def read(self, read_io: ReadIO) -> None:
         path = os.path.join(self.root, read_io.path)
-        byte_range = read_io.byte_range
-        if byte_range is not None:
-            offset, end = byte_range
-            n = end - offset
-            if n >= _NATIVE_WRITE_THRESHOLD:
-                # Single GIL-released pread in a thread (native helper),
-                # landing directly in the BytesIO's own buffer (preallocated
-                # via truncate) — no second allocation or copy.
-                loop = asyncio.get_running_loop()
-                bio = io.BytesIO()
-                # Preallocate n bytes in place (truncate does not extend).
-                bio.seek(n - 1)
-                bio.write(b"\0")
-                view = bio.getbuffer()
-                try:
-                    got = await loop.run_in_executor(
-                        self._get_executor(), _read_range, path, offset, n, view
-                    )
-                finally:
-                    view.release()
-                if got != n:
-                    bio.truncate(got)
-                bio.seek(0)
-                read_io.buf = bio
-                return
-            async with aiofiles.open(path, "rb") as f:
-                await f.seek(offset)
-                read_io.buf = io.BytesIO(await f.read(n))
+        if read_io.byte_range is not None:
+            offset, end = read_io.byte_range
+        else:
+            offset, end = 0, os.path.getsize(path)
+        n = end - offset
+        if n >= _NATIVE_WRITE_THRESHOLD:
+            read_io.buf = await self._native_read(path, offset, n)
             return
         async with aiofiles.open(path, "rb") as f:
-            read_io.buf = io.BytesIO(await f.read())
+            if offset:
+                await f.seek(offset)
+            read_io.buf = io.BytesIO(await f.read(n))
+
+    async def _native_read(self, path: str, offset: int, n: int) -> io.BytesIO:
+        """Single GIL-released pread in a thread (native helper), landing
+        directly in the BytesIO's own buffer — no second allocation/copy."""
+        loop = asyncio.get_running_loop()
+        bio = io.BytesIO()
+        # Preallocate n bytes in place (truncate does not extend).
+        bio.seek(n - 1)
+        bio.write(b"\0")
+        view = bio.getbuffer()
+        try:
+            got = await loop.run_in_executor(
+                self._get_executor(), _read_range, path, offset, n, view
+            )
+        finally:
+            view.release()
+        if got != n:
+            bio.truncate(got)
+        bio.seek(0)
+        return bio
 
     async def delete(self, path: str) -> None:
         full = os.path.join(self.root, path)

@@ -105,16 +105,25 @@ class GCSStoragePlugin(StoragePlugin):
 
     def _upload_chunk(
         self, session_url: str, chunk: memoryview, offset: int, total: int
-    ) -> None:
+    ) -> int:
+        """PUT one chunk; returns the session's new persisted offset. On a
+        308 the response's Range header — not the request size — is
+        authoritative for how much was actually persisted."""
         end = offset + len(chunk)
         headers = {
             "Content-Length": str(len(chunk)),
             "Content-Range": f"bytes {offset}-{end - 1}/{total}",
         }
         resp = self._session.put(session_url, data=bytes(chunk), headers=headers)
-        # 308 = resume incomplete (expected mid-stream); 2xx on final chunk.
-        if resp.status_code not in (200, 201, 308):
-            resp.raise_for_status()
+        if resp.status_code in (200, 201):
+            return total
+        if resp.status_code == 308:
+            persisted = resp.headers.get("Range")
+            if persisted is None:
+                return offset  # nothing persisted from this chunk
+            return int(persisted.rsplit("-", 1)[1]) + 1
+        resp.raise_for_status()
+        return end
 
     def _query_persisted_offset(self, session_url: str, total: int) -> int:
         """Ask the resumable session how many bytes it has durably stored
@@ -218,11 +227,12 @@ class GCSStoragePlugin(StoragePlugin):
         while offset < total:
             chunk = buf[offset : offset + _UPLOAD_CHUNK_SIZE]
             try:
-                await loop.run_in_executor(
+                new_offset = await loop.run_in_executor(
                     self._executor, self._upload_chunk, session_url, chunk, offset, total
                 )
-                self._retry.report_progress()
-                offset += len(chunk)
+                if new_offset > offset:
+                    self._retry.report_progress()
+                offset = new_offset
                 attempt = 0
             except Exception as e:
                 attempt += 1
