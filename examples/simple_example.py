@@ -7,7 +7,11 @@ Run: python examples/simple_example.py [--resume-from PATH]
 """
 
 import argparse
+import os
+import sys
 import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

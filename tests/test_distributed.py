@@ -154,6 +154,7 @@ def _world_global_mesh_sharded(snap_dir):
 
     snap = Snapshot.take(snap_dir, {"s": StateDict(a=arr)})
     entry = snap.get_manifest().get("0/s/a") or snap.get_manifest().get("1/s/a")
+    assert entry is not None, "sharded entry missing from gathered manifest"
 
     # Restore into the same global sharding.
     dst_arr = jax.make_array_from_callback(

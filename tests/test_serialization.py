@@ -4,6 +4,7 @@ tests/test_serialization.py:32-101."""
 import numpy as np
 import pytest
 
+from tpusnap.test_utils import rand_array
 from tpusnap.serialization import (
     SUPPORTED_DTYPES,
     Serializer,
@@ -16,24 +17,6 @@ from tpusnap.serialization import (
     string_to_dtype,
     tensor_nbytes,
 )
-
-
-def rand_array(dtype_str: str, shape=(16, 9)) -> np.ndarray:
-    """Random array of any supported dtype with full bit diversity."""
-    rng = np.random.default_rng(42)
-    dtype = string_to_dtype(dtype_str)
-    raw = rng.integers(0, 256, size=(*shape, dtype.itemsize), dtype=np.uint8)
-    if dtype_str == "bool":
-        return (raw[..., 0] & 1).astype(bool)
-    if dtype_str.startswith("float") or dtype_str.startswith("bfloat"):
-        # keep finite values so equality checks aren't confounded by NaN
-        base = rng.standard_normal(shape).astype(np.float32)
-        return base.astype(dtype)
-    if dtype_str.startswith("complex"):
-        return (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)).astype(
-            dtype
-        )
-    return raw.view(dtype).reshape(*shape, -1)[..., 0].copy()
 
 
 @pytest.mark.parametrize("dtype_str", sorted(SUPPORTED_DTYPES))

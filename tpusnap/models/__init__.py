@@ -1,0 +1,18 @@
+"""Flagship models: TPU-first reference workloads for tpusnap.
+
+The reference library ships example *training scripts* (DDP / FSDP /
+torchrec DLRM, SURVEY.md §2 #23-24) but no model code of its own. tpusnap
+ships one flagship decoder transformer whose parameter pytree exercises
+every sharding family the checkpoint preparers must handle — DP
+(replicated), FSDP (param-sharded), TP (tensor-parallel), SP/CP (ring
+attention over a sequence axis) and EP (expert-sharded MoE weights).
+"""
+
+from .transformer import (  # noqa: F401
+    Transformer,
+    TransformerConfig,
+    make_mesh,
+    make_train_step,
+)
+
+__all__ = ["Transformer", "TransformerConfig", "make_mesh", "make_train_step"]

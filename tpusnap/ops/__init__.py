@@ -1,0 +1,12 @@
+"""TPU-native ops: collective attention kernels for long-context models.
+
+The reference (torchsnapshot) ships no model ops — checkpointing of
+SP/CP-sharded state reduces to sharded arrays (SURVEY.md §5,
+"Long-context/sequence parallelism"). tpusnap ships the ops anyway so its
+flagship model exercises every sharding the preparers must round-trip:
+ring attention gives sequence/context parallelism over a mesh axis.
+"""
+
+from .ring_attention import ring_attention  # noqa: F401
+
+__all__ = ["ring_attention"]

@@ -1,0 +1,119 @@
+"""Ring attention: causal attention over a sequence sharded on a mesh axis.
+
+Each device holds one contiguous block of the sequence. K/V blocks rotate
+around the ring via ``lax.ppermute`` while every device accumulates its
+queries' attention output with an online (flash-style) softmax, so the
+full sequence is never materialized on any chip and the per-step
+``ppermute`` rides the ICI ring concurrently with the block matmuls.
+
+Designed for use inside ``jax.shard_map`` with the sequence dimension
+sharded over ``axis_name``. Pure ``lax`` control flow (``fori_loop`` +
+``ppermute``) — traces once, compiles to a static XLA loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30  # finite mask value: keeps exp() arithmetic NaN-free
+
+
+def _block_attend(q, k, v, m, l, o, q_pos, k_pos, scale, causal):
+    """One online-softmax accumulation step against a single K/V block.
+
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]; m/l: [b, h, sq]; o like q.
+    q_pos/k_pos: global token positions of the local q block and the
+    currently-held k block — needed for causal masking across the ring.
+    """
+    # [b, h, sq, sk]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]  # [sq, sk]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    else:
+        mask = None
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)  # rescale of previous accumulators
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        # A fully-masked row leaves m_new == m == _NEG_INF and p == 1;
+        # zeroing by the mask keeps such rows contributing nothing.
+        p = jnp.where(mask[None, None], p, 0.0)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str] = None,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Causal attention with the sequence sharded over ``axis_name``.
+
+    Args:
+      q, k, v: local blocks ``[batch, seq_local, heads, head_dim]`` of a
+        globally ``[batch, seq, heads, head_dim]`` array sharded on dim 1
+        over ``axis_name``. With ``axis_name=None`` this degrades to plain
+        (single-block flash) attention — the single-device path.
+      causal: apply a causal mask in *global* positions.
+      scale: softmax scale; defaults to ``head_dim ** -0.5``.
+
+    Returns:
+      Local attention output block, same shape/dtype as ``q``.
+    """
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    in_dtype = q.dtype
+    qf = q.astype(jnp.float32)
+
+    if axis_name is None:
+        ring_size, my_idx = 1, 0
+    else:
+        ring_size = lax.psum(1, axis_name)
+        my_idx = lax.axis_index(axis_name)
+
+    q_pos = my_idx * sq + jnp.arange(sq)
+    # Accumulators derive from q (×0) so they carry q's varying-manual-axes
+    # type under shard_map — a plain jnp.zeros carry would be rejected by
+    # lax.fori_loop as unvarying-in / varying-out.
+    zero_bhs = qf[..., 0].transpose(0, 2, 1) * 0.0  # [b, h, sq]
+    m0 = zero_bhs + _NEG_INF
+    l0 = zero_bhs
+    o0 = qf * 0.0
+
+    def body(step, carry):
+        k_blk, v_blk, m, l, o = carry
+        # After `step` rotations this device holds the block originally
+        # owned by ring neighbor (my_idx - step) mod ring_size.
+        src_idx = (my_idx - step) % ring_size
+        k_pos = src_idx * sq + jnp.arange(sq)
+        m, l, o = _block_attend(qf, k_blk, v_blk, m, l, o, q_pos, k_pos, scale, causal)
+        if axis_name is not None:
+            perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, o
+
+    if axis_name is None:
+        _, _, m, l, o = body(0, (k, v, m0, l0, o0))
+    else:
+        _, _, m, l, o = lax.fori_loop(0, ring_size, body, (k, v, m0, l0, o0))
+
+    # l is strictly positive for causal (diagonal always attends) and for
+    # non-causal (every block attends); guard anyway for masked variants.
+    l = jnp.maximum(l, 1e-20)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(in_dtype)

@@ -30,6 +30,12 @@ _TRANSIENT_STATUS = {408, 429, 500, 502, 503, 504}
 _DEFAULT_DEADLINE_SEC = 600
 
 
+class _NoProgressError(ConnectionError):
+    """Resumable-upload PUT was accepted (308) but persisted no bytes;
+    subclasses ConnectionError so ``_is_transient`` retries it under the
+    collective deadline."""
+
+
 def _is_transient(exc: Exception) -> bool:
     status = getattr(getattr(exc, "response", None), "status_code", None)
     if status in _TRANSIENT_STATUS:
@@ -230,10 +236,15 @@ class GCSStoragePlugin(StoragePlugin):
                 new_offset = await loop.run_in_executor(
                     self._executor, self._upload_chunk, session_url, chunk, offset, total
                 )
-                if new_offset > offset:
-                    self._retry.report_progress()
-                offset = new_offset
-                attempt = 0
+                if new_offset <= offset:
+                    # A 308 that persisted nothing (no/stale Range header)
+                    # must count as a failed attempt — otherwise a wedged
+                    # session would re-PUT the same chunk in a tight loop,
+                    # never consulting the collective deadline.
+                    raise _NoProgressError(
+                        f"GCS resumable upload made no progress at offset "
+                        f"{offset}/{total}"
+                    )
             except Exception as e:
                 attempt += 1
                 await self._retry_gate(e, attempt)
@@ -247,6 +258,10 @@ class GCSStoragePlugin(StoragePlugin):
                     total,
                     counts_as_progress=False,
                 )
+                continue
+            self._retry.report_progress()
+            offset = new_offset
+            attempt = 0
 
     async def read(self, read_io: ReadIO) -> None:
         name = self._object_name(read_io.path)
