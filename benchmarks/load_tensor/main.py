@@ -1,0 +1,59 @@
+"""Memory-budgeted random access: load one big array under a 100MB cap.
+
+Mirrors /root/reference/benchmarks/load_tensor/main.py:24-61 (10GB
+tensor, 100MB budget): ``read_object`` splits the read into byte-ranged
+tiles so peak host RSS stays near the budget instead of the full array
+size, validated with the RSS profiler.
+
+Run: python benchmarks/load_tensor/main.py [--gb 1.0]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+from tpusnap import PytreeState, Snapshot, measure_rss_deltas
+
+BUDGET = 100 * 1024 * 1024
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gb", type=float, default=1.0)
+    args = parser.parse_args()
+
+    n_rows = int(args.gb * 1024**3) // (4 * 1024)  # 1024 f32 cols per row
+    arr = np.random.default_rng(0).standard_normal((n_rows, 1024)).astype(np.float32)
+
+    with tempfile.TemporaryDirectory(prefix="tpusnap_bench_load_") as work_dir:
+        path = os.path.join(work_dir, "snap")
+        Snapshot.take(path, {"m": PytreeState({"big": arr})})
+        snapshot = Snapshot(path)
+
+        for label, budget in (("unbudgeted", None), (f"{BUDGET >> 20}MB budget", BUDGET)):
+            deltas = []
+            t0 = time.perf_counter()
+            with measure_rss_deltas(deltas):
+                out = snapshot.read_object(
+                    "0/m/leaves/0", memory_budget_bytes=budget
+                )
+            load_s = time.perf_counter() - t0
+            assert out.shape == arr.shape
+            del out
+            print(
+                f"read_object {label}: {load_s:.2f}s "
+                f"({arr.nbytes / load_s / 1e9:.2f} GB/s), "
+                f"peak RSS delta {max(deltas) / 1e6:.0f} MB"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Replicated (DDP-analog) snapshot benchmark.
+
+Mirrors /root/reference/benchmarks/ddp/main.py:53-70: N data-parallel
+ranks hold identical state; compare
+
+- ``pickle.dump`` from rank 0 only (the ``torch.save`` baseline), vs
+- ``Snapshot.take(replicated=["**"])`` — write load spread over all
+  ranks by the partitioner.
+
+Run: python benchmarks/replicated/main.py [--world-size 2] [--gb 1.0]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+def worker(work_dir: str, gb: str) -> None:
+    import numpy as np
+
+    import jax
+
+    from tpusnap import PytreeState, Snapshot
+    from tpusnap.comm import get_communicator
+
+    rank = jax.process_index()
+    nbytes = int(float(gb) * 1024**3)
+    n_arrays = 8
+    rng = np.random.default_rng(0)  # same seed → identical state per rank
+    state = {
+        f"w{i}": rng.integers(0, 2**16, nbytes // n_arrays // 2, dtype=np.uint16)
+        for i in range(n_arrays)
+    }
+
+    comm = get_communicator()
+    # Baseline: single-rank pickle (the torch.save analog).
+    if rank == 0:
+        import pickle
+
+        t0 = time.perf_counter()
+        with open(os.path.join(work_dir, "baseline.pkl"), "wb") as f:
+            pickle.dump(state, f, protocol=4)
+        baseline_s = time.perf_counter() - t0
+        print(f"baseline pickle.dump: {baseline_s:.2f}s "
+              f"({nbytes / baseline_s / 1e9:.2f} GB/s)")
+    comm.barrier()
+
+    t0 = time.perf_counter()
+    Snapshot.take(
+        os.path.join(work_dir, "snap"), {"m": PytreeState(state)}, replicated=["**"]
+    )
+    take_s = time.perf_counter() - t0
+    if rank == 0:
+        print(f"Snapshot.take (replicated, world={comm.world_size}): "
+              f"{take_s:.2f}s ({nbytes / take_s / 1e9:.2f} GB/s)")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world-size", type=int, default=2)
+    parser.add_argument("--gb", type=float, default=1.0)
+    args = parser.parse_args()
+
+    from tpusnap.test_utils import run_subprocess_world
+
+    with tempfile.TemporaryDirectory(prefix="tpusnap_bench_repl_") as work_dir:
+        outputs = run_subprocess_world(
+            worker,
+            world_size=args.world_size,
+            args=[work_dir, str(args.gb)],
+            timeout=600.0,
+        )
+    for line in outputs[0].strip().splitlines():
+        if "GB/s" in line:
+            print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Tests for auxiliary subsystems: host offload (UVM analog), RSS
+profiler, and the orbax drop-in trick."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusnap import (
+    PytreeState,
+    Snapshot,
+    is_host_resident,
+    measure_rss_deltas,
+    supports_host_offload,
+    to_device,
+    to_host_offload,
+)
+from tpusnap.tricks.orbax import PyTreeCheckpointer
+
+
+class TestHostOffload:
+    def test_supports_on_cpu_backend(self):
+        assert supports_host_offload()
+
+    def test_roundtrip_and_predicates(self):
+        x = jnp.arange(64, dtype=jnp.float32)
+        assert not is_host_resident(x)  # default memory kind "device"
+        xh = to_host_offload(x, "unpinned_host")
+        assert is_host_resident(xh)
+        np.testing.assert_array_equal(np.asarray(xh), np.asarray(x))
+        xd = to_device(xh)
+        assert not is_host_resident(xd)
+        np.testing.assert_array_equal(np.asarray(xd), np.asarray(x))
+
+    def test_numpy_is_host_resident(self):
+        assert is_host_resident(np.zeros(4))
+
+    def test_snapshot_of_host_offloaded_array(self, tmp_path):
+        """The UVM-embedding scenario: host-resident state snapshots and
+        restores like any other array (reference gpu_tests/test_torchrec
+        UVM cases)."""
+        x = to_host_offload(jnp.arange(1024, dtype=jnp.float32), "unpinned_host")
+        Snapshot.take(str(tmp_path / "s"), {"m": PytreeState({"emb": x})})
+        target = PytreeState({"emb": jnp.zeros(1024, jnp.float32)})
+        Snapshot(str(tmp_path / "s")).restore({"m": target})
+        np.testing.assert_array_equal(
+            np.asarray(target.tree["emb"]), np.asarray(x)
+        )
+
+
+class TestRSSProfiler:
+    def test_samples_collected(self):
+        deltas = []
+        with measure_rss_deltas(deltas, interval_sec=0.01):
+            buf = np.ones(2_000_000)  # ~16MB
+            time.sleep(0.05)
+            del buf
+        assert len(deltas) >= 2
+        assert max(deltas) > 0
+
+
+class TestOrbaxTrick:
+    def test_save_restore_with_target(self, tmp_path):
+        ckpt = PyTreeCheckpointer()
+        tree = {"w": jnp.arange(16.0), "nested": {"b": np.ones((4, 4)), "n": 3}}
+        ckpt.save(tmp_path / "ck", tree)
+        target = jax.tree.map(
+            lambda x: x * 0 if hasattr(x, "dtype") else 0, tree
+        )
+        out = ckpt.restore(tmp_path / "ck", target)
+        assert jax.tree.structure(out) == jax.tree.structure(tree)
+        for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_restore_without_target_returns_leaves(self, tmp_path):
+        ckpt = PyTreeCheckpointer()
+        tree = {"a": jnp.ones(3), "b": 7}
+        ckpt.save(tmp_path / "ck", tree)
+        leaves = ckpt.restore(tmp_path / "ck")
+        assert len(leaves) == 2
+
+    def test_force_overwrites(self, tmp_path):
+        ckpt = PyTreeCheckpointer()
+        ckpt.save(tmp_path / "ck", {"a": jnp.ones(3)})
+        ckpt.save(tmp_path / "ck", {"a": jnp.zeros(3)}, force=True)
+        out = ckpt.restore(tmp_path / "ck", {"a": jnp.ones(3)})
+        np.testing.assert_array_equal(np.asarray(out["a"]), np.zeros(3))
+
+    def test_async_save(self, tmp_path):
+        ckpt = PyTreeCheckpointer()
+        pending = ckpt.async_save(tmp_path / "ck", {"a": jnp.arange(8.0)})
+        snapshot = pending.wait()
+        out = ckpt.restore(snapshot.path, {"a": jnp.zeros(8)})
+        np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(8.0))

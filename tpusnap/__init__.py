@@ -17,6 +17,13 @@ try:  # pragma: no cover - import surface grows as modules land
     from .rng_state import RNGState  # noqa: F401
     from .pytree_state import PytreeState  # noqa: F401
     from .snapshot import PendingSnapshot, Snapshot  # noqa: F401
+    from .host_offload import (  # noqa: F401
+        is_host_resident,
+        supports_host_offload,
+        to_device,
+        to_host_offload,
+    )
+    from .rss_profiler import measure_rss_deltas  # noqa: F401
 
     __all__ += [
         "Snapshot",
@@ -26,6 +33,11 @@ try:  # pragma: no cover - import surface grows as modules land
         "StateDict",
         "RNGState",
         "PytreeState",
+        "to_host_offload",
+        "to_device",
+        "is_host_resident",
+        "supports_host_offload",
+        "measure_rss_deltas",
     ]
 except ModuleNotFoundError as e:  # modules not created yet during bootstrap
     # Only swallow "tpusnap.X does not exist yet"; a failure inside an

@@ -1,0 +1,60 @@
+"""Host-offloaded arrays — the TPU analog of CUDA UVM embedding tables.
+
+Counterpart of /root/reference/torchsnapshot/uvm_tensor.py:24-39, which
+binds fbgemm_gpu's CUDA unified-virtual-memory ops so huge embedding
+tables live in host RAM while remaining addressable from the GPU. On TPU
+the same capability is XLA memory kinds: ``pinned_host`` /
+``unpinned_host`` arrays live in host memory, are directly usable from
+jitted computations (XLA inserts the DMAs), and — exactly like the
+reference's ``_uvm_to_cpu`` staging shortcut
+(io_preparers/tensor.py:257-259) — serialize without a device→host copy.
+
+All helpers degrade gracefully when a backend lacks host memory kinds
+(mirroring the reference's no-op fallbacks when fbgemm is absent).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_HOST_MEMORY_KINDS = frozenset({"pinned_host", "unpinned_host"})
+
+
+def supports_host_offload(device: Optional[jax.Device] = None) -> bool:
+    device = device or jax.devices()[0]
+    try:
+        kinds = {m.kind for m in device.addressable_memories()}
+    except Exception:
+        return False
+    return bool(kinds & _HOST_MEMORY_KINDS)
+
+
+def is_host_resident(arr: object) -> bool:
+    """True when the array's buffers live in host memory (UVM analog of
+    the reference's ``_is_uvm_tensor``)."""
+    if not isinstance(arr, jax.Array):
+        return True  # numpy et al. are host memory by definition
+    try:
+        return arr.sharding.memory_kind in _HOST_MEMORY_KINDS
+    except Exception:
+        return False
+
+
+def to_host_offload(arr: jax.Array, memory_kind: str = "pinned_host") -> jax.Array:
+    """Move an array to host memory, preserving its sharding layout
+    (reference ``new_managed_tensor``: allocate in UVM)."""
+    if memory_kind not in _HOST_MEMORY_KINDS:
+        raise ValueError(f"not a host memory kind: {memory_kind!r}")
+    sharding = arr.sharding.with_memory_kind(memory_kind)
+    return jax.device_put(arr, sharding)
+
+
+def to_device(arr: jax.Array) -> jax.Array:
+    """Move a host-offloaded array back to device HBM."""
+    sharding = arr.sharding.with_memory_kind("device")
+    return jax.device_put(arr, sharding)

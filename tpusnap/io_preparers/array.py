@@ -61,8 +61,15 @@ def is_supported_array_dtype(arr: ArrayLike) -> bool:
 
 
 def enqueue_dtoh(arr: ArrayLike) -> None:
-    """Start the device→host DMA early (overlaps with scheduling)."""
-    if isinstance(arr, jax.Array):
+    """Start the device→host DMA early (overlaps with scheduling).
+
+    Host-offloaded arrays (host_offload.py, the UVM analog) skip the
+    enqueue: their buffers already live in host memory, so staging is a
+    plain view — the reference's uvm_to_cpu shortcut
+    (io_preparers/tensor.py:257-259)."""
+    from ..host_offload import is_host_resident
+
+    if isinstance(arr, jax.Array) and not is_host_resident(arr):
         try:
             arr.copy_to_host_async()
         except Exception:

@@ -1,0 +1,44 @@
+"""RSS profiler — validate that the memory-budget-gated pipeline holds.
+
+Counterpart of /root/reference/torchsnapshot/rss_profiler.py:32-56: a
+background thread samples the process RSS delta on an interval inside a
+context manager; benchmarks assert the peak delta stays within the
+configured memory budget.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Generator, List
+
+import psutil
+
+_DEFAULT_INTERVAL_SEC = 0.1
+
+
+@contextmanager
+def measure_rss_deltas(
+    rss_deltas: List[int], interval_sec: float = _DEFAULT_INTERVAL_SEC
+) -> Generator[None, None, None]:
+    """Append RSS deltas (bytes, relative to entry) to ``rss_deltas`` every
+    ``interval_sec`` until the context exits (reference rss_profiler.py:33-56).
+    """
+    process = psutil.Process()
+    baseline = process.memory_info().rss
+    stop = threading.Event()
+
+    def sample() -> None:
+        while not stop.is_set():
+            rss_deltas.append(process.memory_info().rss - baseline)
+            time.sleep(interval_sec)
+
+    thread = threading.Thread(target=sample, name="tpusnap-rss", daemon=True)
+    thread.start()
+    try:
+        yield
+    finally:
+        stop.set()
+        thread.join()
+        rss_deltas.append(process.memory_info().rss - baseline)

@@ -81,6 +81,31 @@ def find_free_port() -> int:
         return s.getsockname()[1]
 
 
+def apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS / --xla_force_host_platform_device_count in
+    processes where a sitecustomize already registered a TPU backend.
+
+    This environment pre-loads PYTHONPATH=/root/.axon_site whose
+    sitecustomize registers the real-TPU "axon" platform at interpreter
+    startup — by then the JAX_PLATFORMS env var has already been read.
+    ``jax.config.update`` still works until devices are first queried, so
+    examples/benchmarks call this before touching jax.devices().
+    """
+    import re
+
+    import jax
+
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        jax.config.update("jax_platforms", platforms)
+    match = re.search(
+        r"xla_force_host_platform_device_count=(\d+)",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    if match:
+        jax.config.update("jax_num_cpu_devices", int(match.group(1)))
+
+
 def run_subprocess_world(
     fn: Callable[[], None],
     world_size: int,
@@ -100,8 +125,15 @@ def run_subprocess_world(
     # when it lives outside the repo (a user's own script directory).
     module = sys.modules.get(fn.__module__)
     module_dir = ""
+    module_name = fn.__module__
     if module is not None and getattr(module, "__file__", None):
-        module_dir = os.path.dirname(os.path.abspath(module.__file__))
+        module_path = os.path.abspath(module.__file__)
+        module_dir = os.path.dirname(module_path)
+        if module_name == "__main__":
+            # fn was defined in a directly-run script; the subprocess must
+            # re-import it by file name, not as "__main__" (which would be
+            # tpusnap.test_utils's own entry point there).
+            module_name = os.path.splitext(os.path.basename(module_path))[0]
     for rank in range(world_size):
         env = dict(env_base)
         env.update(
@@ -123,7 +155,7 @@ def run_subprocess_world(
                     sys.executable,
                     "-m",
                     "tpusnap.test_utils",
-                    fn.__module__,
+                    module_name,
                     fn.__qualname__,
                     *(args or []),
                 ],

@@ -1,0 +1,4 @@
+"""Integration tricks: route other frameworks' checkpoint paths through
+tpusnap (counterpart of /root/reference/torchsnapshot/tricks/deepspeed.py,
+which monkey-patches DeepSpeedEngine._save_zero_checkpoint onto
+Snapshot.async_take)."""
