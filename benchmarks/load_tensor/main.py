@@ -38,7 +38,10 @@ def main() -> None:
         Snapshot.take(path, {"m": PytreeState({"big": arr})})
         snapshot = Snapshot(path)
 
-        for label, budget in (("unbudgeted", None), (f"{BUDGET >> 20}MB budget", BUDGET)):
+        # Budgeted pass first: it must see a clean RSS baseline — a prior
+        # unbudgeted pass leaves the allocator's retained pages inflated
+        # and would make the budget check vacuous.
+        for label, budget in ((f"{BUDGET >> 20}MB budget", BUDGET), ("unbudgeted", None)):
             deltas = []
             t0 = time.perf_counter()
             with measure_rss_deltas(deltas):

@@ -38,6 +38,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.flash_attention import flash_attention
 from ..ops.ring_attention import ring_attention
 
 Params = Dict[str, Any]
@@ -55,6 +56,11 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32
     use_ring_attention: bool = False  # shard the sequence over "fsdp" (CP)
+    # Non-ring attention implementation: "auto" → Pallas flash kernel on
+    # TPU backends, plain-XLA online softmax elsewhere; "flash" forces
+    # the Pallas kernel (interpreter mode off-TPU); "reference" forces
+    # the XLA path.
+    attention_impl: str = "auto"
     rope_theta: float = 10000.0
 
     @property
@@ -188,7 +194,15 @@ class Transformer:
                 out_specs=spec,
             )(q, k, v)
         else:
-            out = ring_attention(q, k, v, axis_name=None, causal=True)
+            impl = cfg.attention_impl
+            if impl not in ("auto", "flash", "reference"):
+                raise ValueError(f"unknown attention_impl: {impl!r}")
+            if impl == "auto":
+                impl = "flash" if jax.default_backend() == "tpu" else "reference"
+            if impl == "flash":
+                out = flash_attention(q, k, v, causal=True)
+            else:
+                out = ring_attention(q, k, v, axis_name=None, causal=True)
         out = out.reshape(b, s, cfg.d_model)
         return jnp.einsum("bsd,dz->bsz", out, lp["wo"].astype(cfg.dtype))
 

@@ -7,6 +7,7 @@ flagship model exercises every sharding the preparers must round-trip:
 ring attention gives sequence/context parallelism over a mesh axis.
 """
 
+from .flash_attention import flash_attention  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 
-__all__ = ["ring_attention"]
+__all__ = ["flash_attention", "ring_attention"]

@@ -53,9 +53,11 @@ class PyTreeCheckpointer:
         if item is None:
             snapshot = Snapshot(path)
             manifest = snapshot.get_manifest()
+            # Dedupe on the logical path: sharded entries appear once per
+            # rank under "<rank>/<logical_path>" keys (manifest_ops.py).
             n_leaves = len(
                 {
-                    p
+                    p.split("/", 1)[1]
                     for p in manifest
                     if p.split("/", 1)[1].startswith(f"{self._KEY}/leaves/")
                 }
