@@ -16,6 +16,7 @@ _MAX_CHUNK_SIZE_ENV_VAR = "TPUSNAP_MAX_CHUNK_SIZE_BYTES"
 _MAX_SHARD_SIZE_ENV_VAR = "TPUSNAP_MAX_SHARD_SIZE_BYTES"
 _SLAB_SIZE_THRESHOLD_ENV_VAR = "TPUSNAP_SLAB_SIZE_THRESHOLD_BYTES"
 _DISABLE_BATCHING_ENV_VAR = "TPUSNAP_DISABLE_BATCHING"
+_DISABLE_DEVICE_BATCHING_ENV_VAR = "TPUSNAP_DISABLE_DEVICE_BATCHING"
 _DISABLE_PARTITIONER_ENV_VAR = "TPUSNAP_DISABLE_PARTITIONER"
 _MEMORY_BUDGET_ENV_VAR = "TPUSNAP_MAX_PER_RANK_MEMORY_BUDGET_BYTES"
 _DISABLE_NATIVE_ENV_VAR = "TPUSNAP_DISABLE_NATIVE"
@@ -52,6 +53,10 @@ def get_slab_size_threshold_bytes() -> int:
 
 def is_batching_disabled() -> bool:
     return os.environ.get(_DISABLE_BATCHING_ENV_VAR, "0") == "1"
+
+
+def is_device_batching_disabled() -> bool:
+    return os.environ.get(_DISABLE_DEVICE_BATCHING_ENV_VAR, "0") == "1"
 
 
 def is_partitioner_disabled() -> bool:
@@ -106,6 +111,12 @@ def override_slab_size_threshold_bytes(nbytes: int) -> Generator[None, None, Non
 @contextlib.contextmanager
 def override_batching_disabled(disabled: bool) -> Generator[None, None, None]:
     with _override_env(_DISABLE_BATCHING_ENV_VAR, "1" if disabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_device_batching_disabled(disabled: bool) -> Generator[None, None, None]:
+    with _override_env(_DISABLE_DEVICE_BATCHING_ENV_VAR, "1" if disabled else "0"):
         yield
 
 

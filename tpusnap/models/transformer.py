@@ -63,6 +63,10 @@ class TransformerConfig:
     attention_impl: str = "auto"
     rope_theta: float = 10000.0
 
+    def __post_init__(self) -> None:
+        if self.attention_impl not in ("auto", "flash", "reference"):
+            raise ValueError(f"unknown attention_impl: {self.attention_impl!r}")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
@@ -195,8 +199,6 @@ class Transformer:
             )(q, k, v)
         else:
             impl = cfg.attention_impl
-            if impl not in ("auto", "flash", "reference"):
-                raise ValueError(f"unknown attention_impl: {impl!r}")
             if impl == "auto":
                 impl = "flash" if jax.default_backend() == "tpu" else "reference"
             if impl == "flash":

@@ -166,7 +166,9 @@ class _WritePipeline:
 
     async def stage(self, executor: ThreadPoolExecutor) -> "_WritePipeline":
         self.buf = await self.write_req.buffer_stager.stage_buffer(executor)
-        self.buf_size = len(memoryview(self.buf).cast("B")) if self.buf else 0
+        self.buf_size = (
+            memoryview(self.buf).cast("B").nbytes if self.buf is not None else 0
+        )
         return self
 
     async def write(self) -> "_WritePipeline":
