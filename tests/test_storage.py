@@ -161,3 +161,28 @@ class TestNative:
             [sys.executable, "-c", code], capture_output=True, text=True, cwd="/root/repo"
         )
         assert "fallback-ok" in out.stdout, out.stderr
+
+
+def test_register_storage_plugin_runtime(tmp_path):
+    """Runtime-registered schemes take effect without packaging
+    (complements the entry-point group)."""
+    from tpusnap.storage_plugin import (
+        _RUNTIME_REGISTRY,
+        register_storage_plugin,
+        url_to_storage_plugin,
+    )
+    from tpusnap.storage_plugins.fs import FSStoragePlugin
+
+    calls = {}
+
+    def factory(path, storage_options):
+        calls["path"] = path
+        return FSStoragePlugin(root=str(tmp_path / path), storage_options=storage_options)
+
+    register_storage_plugin("memtest", factory)
+    try:
+        plugin = url_to_storage_plugin("memtest://sub/dir")
+        assert isinstance(plugin, FSStoragePlugin)
+        assert calls["path"] == "sub/dir"
+    finally:
+        _RUNTIME_REGISTRY.pop("memtest", None)

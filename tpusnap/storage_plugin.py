@@ -14,6 +14,17 @@ from .io_types import StoragePlugin
 
 _ENTRY_POINT_GROUP = "tpusnap.storage_plugins"
 
+# scheme → factory(path, storage_options) registered at runtime; consulted
+# before entry points so tests/apps can inject plugins without packaging.
+_RUNTIME_REGISTRY: Dict[str, Any] = {}
+
+
+def register_storage_plugin(scheme: str, factory: Any) -> None:
+    """Register ``factory(path, storage_options) -> StoragePlugin`` for a
+    URL scheme at runtime (complements the ``tpusnap.storage_plugins``
+    entry-point group, reference storage_plugin.py:53-65)."""
+    _RUNTIME_REGISTRY[scheme.lower()] = factory
+
 
 def url_to_storage_plugin(
     url_path: str, storage_options: Optional[Dict[str, Any]] = None
@@ -25,6 +36,8 @@ def url_to_storage_plugin(
         scheme, path = "fs", url_path
     scheme = scheme.lower()
 
+    if scheme in _RUNTIME_REGISTRY:
+        return _RUNTIME_REGISTRY[scheme](path, storage_options)
     if scheme in ("", "fs", "file"):
         from .storage_plugins.fs import FSStoragePlugin
 
