@@ -48,10 +48,13 @@ def main() -> None:
     nbytes = sum(a.nbytes for a in state.values())
 
     times = []
-    for _ in range(2):
+    for _ in range(3):
         tmp = tempfile.mkdtemp(prefix="tpusnap_bench_")
         try:
             app_state = {"model": PytreeState(state)}
+            # Drain pending page-cache writeback from earlier iterations so
+            # each timed take competes only with its own I/O.
+            os.sync()
             t0 = time.perf_counter()
             Snapshot.take(os.path.join(tmp, "snap"), app_state)
             times.append(time.perf_counter() - t0)

@@ -13,6 +13,10 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tpusnap.test_utils import apply_platform_env
+
+apply_platform_env()  # honor JAX_PLATFORMS even under a sitecustomize backend
+
 import jax
 import jax.numpy as jnp
 import numpy as np

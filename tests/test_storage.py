@@ -64,6 +64,23 @@ def test_fs_large_write_native_path(tmp_path):
     _run(go())
 
 
+def test_fs_direct_io_roundtrip(tmp_path):
+    """O_DIRECT writes must be bit-exact for unaligned sizes (the aligned
+    bulk goes through the direct fd, the tail through a buffered one) and
+    the knob must force the buffered path."""
+    from tpusnap import _native
+    from tpusnap.knobs import override_direct_io_disabled
+
+    for nbytes in (4 * 1024 * 1024, 8 * 1024 * 1024 + 4096, 9 * 1024 * 1024 + 7):
+        data = os.urandom(nbytes)
+        for disabled in (False, True):
+            with override_direct_io_disabled(disabled):
+                path = str(tmp_path / f"d{nbytes}_{disabled}")
+                _native.write_file(path, memoryview(data))
+                with open(path, "rb") as f:
+                    assert f.read() == data
+
+
 def test_fs_concurrent_writes(tmp_path):
     plugin = FSStoragePlugin(root=str(tmp_path))
 
@@ -167,8 +184,8 @@ def test_register_storage_plugin_runtime(tmp_path):
     """Runtime-registered schemes take effect without packaging
     (complements the entry-point group)."""
     from tpusnap.storage_plugin import (
-        _RUNTIME_REGISTRY,
         register_storage_plugin,
+        unregister_storage_plugin,
         url_to_storage_plugin,
     )
     from tpusnap.storage_plugins.fs import FSStoragePlugin
@@ -185,4 +202,6 @@ def test_register_storage_plugin_runtime(tmp_path):
         assert isinstance(plugin, FSStoragePlugin)
         assert calls["path"] == "sub/dir"
     finally:
-        _RUNTIME_REGISTRY.pop("memtest", None)
+        unregister_storage_plugin("memtest")
+    with pytest.raises(RuntimeError):
+        url_to_storage_plugin("memtest://sub/dir")

@@ -78,6 +78,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_size_t,
         ]
         lib.ts_write_file.restype = ctypes.c_int
+        lib.ts_write_file_direct.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+        ]
+        lib.ts_write_file_direct.restype = ctypes.c_int
         lib.ts_read_range.argtypes = [
             ctypes.c_char_p,
             ctypes.c_void_p,
@@ -113,21 +119,42 @@ def _ptr(buf) -> Tuple[int, np.ndarray]:
 
 
 def write_file(path: str, buf) -> None:
-    """Whole-buffer file write with the GIL released for the full transfer."""
+    """Whole-buffer file write with the GIL released for the full transfer.
+
+    Large buffers go through the O_DIRECT double-buffered writer (page-cache
+    writeback throttling caps buffered streams far below device speed on
+    multi-GB checkpoints); the native layer falls back to a buffered write
+    automatically when the filesystem rejects O_DIRECT."""
     mv = memoryview(buf).cast("B")
     lib = _load()
     if lib is None:
-        with open(path, "wb", buffering=0) as f:
-            f.write(mv)
+        _write_all(path, mv)
         return
     if mv.nbytes == 0:
         open(path, "wb").close()
         return
+    from ..knobs import is_direct_io_disabled
+
+    fn = lib.ts_write_file if is_direct_io_disabled() else lib.ts_write_file_direct
     ptr, keepalive = _ptr(mv)
-    rc = lib.ts_write_file(path.encode(), ptr, mv.nbytes)
+    rc = fn(path.encode(), ptr, mv.nbytes)
     del keepalive
     if rc != 0:
         raise OSError(-rc, os.strerror(-rc), path)
+
+
+def _write_all(path: str, mv: memoryview) -> None:
+    """Unbuffered write loop: a single ``FileIO.write`` maps to one
+    write(2), which can be short (near-full disk) and is capped at
+    0x7ffff000 bytes on Linux — ignoring its return would silently
+    truncate buffers >= 2 GiB."""
+    with open(path, "wb", buffering=0) as f:
+        pos = 0
+        while pos < mv.nbytes:
+            written = f.write(mv[pos:])
+            if not written:
+                raise OSError(f"short write at {pos}/{mv.nbytes}: {path}")
+            pos += written
 
 
 def read_range(path: str, offset: int, n: int, out) -> int:

@@ -13,8 +13,10 @@ tpusnap instead rides the **coordination-service KV store** that
   would be overkill (SURVEY.md §5).
 
 Like the reference's PGWrapper (pg_wrapper.py:15-30), construction
-auto-detects the environment: single process → no-op collectives;
-``jax.process_count() > 1`` → KV-store-backed collectives.
+auto-detects the environment: single process → no-op collectives; a live
+``jax.distributed`` coordination client with >1 process → KV-store-backed
+collectives. Detection reads the coordination state directly so that
+checkpointing host-resident state never initializes a device backend.
 
 Sequencing: every collective bumps a process-global sequence number.
 Ranks execute the same collectives in the same order (SPMD), so the
@@ -69,8 +71,6 @@ class JaxCoordinationComm(Communicator):
     """KV-store-backed collectives for multi-process jobs."""
 
     def __init__(self, timeout_ms: int = _DEFAULT_TIMEOUT_MS) -> None:
-        import jax
-
         from jax._src import distributed
 
         client = distributed.global_state.client
@@ -81,8 +81,11 @@ class JaxCoordinationComm(Communicator):
                 "processes"
             )
         self._client = client
-        self._rank = jax.process_index()
-        self._world_size = jax.process_count()
+        # Read rank/world from the coordination state, not
+        # jax.process_index()/process_count() — those initialize the device
+        # backend, which checkpointing of host state must never require.
+        self._rank = distributed.global_state.process_id
+        self._world_size = distributed.global_state.num_processes
         self._timeout_ms = timeout_ms
 
     @property
@@ -148,14 +151,59 @@ def _decode(raw) -> Any:
 def get_communicator(comm: Optional[Communicator] = None) -> Communicator:
     """Auto-detect (reference pg_wrapper.py:15-30): explicit comm wins; a
     live multi-process jax.distributed runtime selects the KV-backed
-    implementation; otherwise single-process no-op."""
+    implementation; otherwise single-process no-op.
+
+    Detection deliberately reads ``jax.distributed``'s coordination state
+    instead of calling ``jax.process_count()``: the latter initializes the
+    device backend, which is slow (and can block on flaky hardware links)
+    — and a snapshot of host-resident state must not require a device at
+    all. Multi-process JAX always goes through
+    ``jax.distributed.initialize``, so the coordination client is the
+    authoritative signal."""
     if comm is not None:
         return comm
     try:
+        from jax._src import distributed as _jd
+
+        multi = _jd.global_state.client is not None and (
+            (_jd.global_state.num_processes or 1) > 1
+        )
+        if not multi and _jd.global_state.client is None:
+            # Some multi-host deployments (libtpu auto-bootstrap on TPU
+            # pods) never call jax.distributed.initialize, so there is no
+            # coordination client to ride. If a device backend is ALREADY
+            # live (device-array snapshots imply it is), probing
+            # process_count is free of new backend init — and a >1 answer
+            # with no client means snapshots would collide: fail loudly.
+            # With no backend initialized we stay backend-free and treat
+            # the process as single-process.
+            import jax
+            from jax._src import xla_bridge as _xb
+
+            if getattr(_xb, "_backends", None):
+                if jax.process_count() > 1:
+                    raise RuntimeError(
+                        "This looks like a multi-host JAX job without "
+                        "jax.distributed.initialize(); tpusnap needs the "
+                        "coordination service for cross-host snapshot "
+                        "consistency. Call jax.distributed.initialize() "
+                        "at startup or pass an explicit `comm`."
+                    )
+    except Exception:
+        # The private coordination-state API moved (JAX internals carry no
+        # stability guarantee). JaxCoordinationComm needs that API too, so
+        # there is no degraded mode — but silently treating a multi-host
+        # job as single-process would corrupt snapshots, so probe the
+        # public API (slower: initializes the device backend) and fail
+        # loudly if this really is a multi-process job.
         import jax
 
         if jax.process_count() > 1:
-            return JaxCoordinationComm()
-    except Exception:
-        pass
-    return Communicator()
+            raise RuntimeError(
+                "tpusnap cannot reach JAX's distributed coordination "
+                "client on this JAX version (jax._src.distributed moved); "
+                "multi-process snapshots would be corrupted. Pass an "
+                "explicit `comm` or update tpusnap."
+            )
+        return Communicator()
+    return JaxCoordinationComm() if multi else Communicator()

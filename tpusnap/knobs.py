@@ -20,6 +20,7 @@ _DISABLE_DEVICE_BATCHING_ENV_VAR = "TPUSNAP_DISABLE_DEVICE_BATCHING"
 _DISABLE_PARTITIONER_ENV_VAR = "TPUSNAP_DISABLE_PARTITIONER"
 _MEMORY_BUDGET_ENV_VAR = "TPUSNAP_MAX_PER_RANK_MEMORY_BUDGET_BYTES"
 _DISABLE_NATIVE_ENV_VAR = "TPUSNAP_DISABLE_NATIVE"
+_DISABLE_DIRECT_IO_ENV_VAR = "TPUSNAP_DISABLE_DIRECT_IO"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -65,6 +66,13 @@ def is_partitioner_disabled() -> bool:
 
 def is_native_disabled() -> bool:
     return os.environ.get(_DISABLE_NATIVE_ENV_VAR, "0") == "1"
+
+
+def is_direct_io_disabled() -> bool:
+    """O_DIRECT file writes (fs plugin): on by default; the native layer
+    falls back to buffered writes automatically on filesystems without
+    O_DIRECT support, so this knob exists for debugging/bench A-Bs."""
+    return os.environ.get(_DISABLE_DIRECT_IO_ENV_VAR, "0") == "1"
 
 
 def get_memory_budget_override_bytes() -> Optional[int]:
@@ -123,4 +131,10 @@ def override_device_batching_disabled(disabled: bool) -> Generator[None, None, N
 @contextlib.contextmanager
 def override_memory_budget_bytes(nbytes: int) -> Generator[None, None, None]:
     with _override_env(_MEMORY_BUDGET_ENV_VAR, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_direct_io_disabled(disabled: bool) -> Generator[None, None, None]:
+    with _override_env(_DISABLE_DIRECT_IO_ENV_VAR, "1" if disabled else "0"):
         yield

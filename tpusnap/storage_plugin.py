@@ -26,6 +26,13 @@ def register_storage_plugin(scheme: str, factory: Any) -> None:
     _RUNTIME_REGISTRY[scheme.lower()] = factory
 
 
+def unregister_storage_plugin(scheme: str) -> None:
+    """Remove a runtime-registered scheme (no-op if absent). Runtime
+    registrations shadow the built-in schemes, so scoped users (tests,
+    fault injection) must clean up to avoid redirecting default paths."""
+    _RUNTIME_REGISTRY.pop(scheme.lower(), None)
+
+
 def url_to_storage_plugin(
     url_path: str, storage_options: Optional[Dict[str, Any]] = None
 ) -> StoragePlugin:

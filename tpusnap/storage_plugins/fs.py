@@ -37,8 +37,11 @@ class FSStoragePlugin(StoragePlugin):
 
     def _get_executor(self) -> ThreadPoolExecutor:
         if self._executor is None:
+            # 8 concurrent streams measurably out-run 4 on direct I/O
+            # (deeper device queue); each stream is GIL-released in native
+            # code so the extra threads cost nothing on the Python side.
             self._executor = ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="tpusnap-fs"
+                max_workers=8, thread_name_prefix="tpusnap-fs"
             )
         return self._executor
 
@@ -107,8 +110,7 @@ def _write_file(path: pathlib.Path, buf) -> None:
     if native.available():
         native.write_file(str(path), buf)
         return
-    with open(path, "wb", buffering=0) as f:
-        f.write(buf)
+    native._write_all(str(path), memoryview(buf).cast("B"))
 
 
 def _read_range(path: str, offset: int, n: int, out: bytearray) -> int:
