@@ -46,7 +46,7 @@ def main() -> None:
             t0 = time.perf_counter()
             with measure_rss_deltas(deltas):
                 out = snapshot.read_object(
-                    "0/m/leaves/0", memory_budget_bytes=budget
+                    "0/m/big", memory_budget_bytes=budget
                 )
             load_s = time.perf_counter() - t0
             assert out.shape == arr.shape

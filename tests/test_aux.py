@@ -73,12 +73,14 @@ class TestOrbaxTrick:
         for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(tree)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
-    def test_restore_without_target_returns_leaves(self, tmp_path):
+    def test_restore_without_target_rebuilds_structure(self, tmp_path):
         ckpt = PyTreeCheckpointer()
-        tree = {"a": jnp.ones(3), "b": 7}
+        tree = {"a": jnp.ones(3), "nested": {"b": 7}}
         ckpt.save(tmp_path / "ck", tree)
-        leaves = ckpt.restore(tmp_path / "ck")
-        assert len(leaves) == 2
+        out = ckpt.restore(tmp_path / "ck")
+        assert set(out) == {"a", "nested"}
+        assert out["nested"]["b"] == 7
+        np.testing.assert_array_equal(np.asarray(out["a"]), np.ones(3))
 
     def test_force_overwrites(self, tmp_path):
         ckpt = PyTreeCheckpointer()

@@ -165,30 +165,8 @@ def get_communicator(comm: Optional[Communicator] = None) -> Communicator:
     try:
         from jax._src import distributed as _jd
 
-        multi = _jd.global_state.client is not None and (
-            (_jd.global_state.num_processes or 1) > 1
-        )
-        if not multi and _jd.global_state.client is None:
-            # Some multi-host deployments (libtpu auto-bootstrap on TPU
-            # pods) never call jax.distributed.initialize, so there is no
-            # coordination client to ride. If a device backend is ALREADY
-            # live (device-array snapshots imply it is), probing
-            # process_count is free of new backend init — and a >1 answer
-            # with no client means snapshots would collide: fail loudly.
-            # With no backend initialized we stay backend-free and treat
-            # the process as single-process.
-            import jax
-            from jax._src import xla_bridge as _xb
-
-            if getattr(_xb, "_backends", None):
-                if jax.process_count() > 1:
-                    raise RuntimeError(
-                        "This looks like a multi-host JAX job without "
-                        "jax.distributed.initialize(); tpusnap needs the "
-                        "coordination service for cross-host snapshot "
-                        "consistency. Call jax.distributed.initialize() "
-                        "at startup or pass an explicit `comm`."
-                    )
+        client = _jd.global_state.client
+        nproc = _jd.global_state.num_processes or 1
     except Exception:
         # The private coordination-state API moved (JAX internals carry no
         # stability guarantee). JaxCoordinationComm needs that API too, so
@@ -206,4 +184,38 @@ def get_communicator(comm: Optional[Communicator] = None) -> Communicator:
                 "explicit `comm` or update tpusnap."
             )
         return Communicator()
-    return JaxCoordinationComm() if multi else Communicator()
+
+    if client is not None and nproc > 1:
+        return JaxCoordinationComm()
+
+    if client is None and _backend_initialized():
+        # Some multi-host deployments (libtpu auto-bootstrap on TPU pods)
+        # never call jax.distributed.initialize, so there is no
+        # coordination client to ride. A device backend is already live
+        # (device-array snapshots imply it is), so probing process_count
+        # costs no new backend init — and a >1 answer with no client means
+        # snapshots would collide: fail loudly. With no backend
+        # initialized we stay backend-free and treat the process as
+        # single-process.
+        import jax
+
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "This looks like a multi-host JAX job without "
+                "jax.distributed.initialize(); tpusnap needs the "
+                "coordination service for cross-host snapshot "
+                "consistency. Call jax.distributed.initialize() at "
+                "startup or pass an explicit `comm`."
+            )
+    return Communicator()
+
+
+def _backend_initialized() -> bool:
+    """True when some XLA backend is already live in this process —
+    checked without triggering initialization."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        return bool(getattr(_xb, "_backends", None))
+    except Exception:
+        return False

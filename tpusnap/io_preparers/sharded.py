@@ -271,10 +271,20 @@ class _Assembler:
         if isinstance(obj_out, jax.Array):
             global_shape = tuple(self.entry.shape)
             per_device = []
+            # Preserve the target's memory kind: a host-offloaded (UVM
+            # analog) target must get pinned_host buffers, not HBM ones.
+            memory_kind = getattr(obj_out.sharding, "memory_kind", None)
             for shard in obj_out.addressable_shards:
                 offsets, sizes = _index_to_box(shard.index, list(global_shape))
                 piece = self._piece_by_key[tuple(offsets) + tuple(sizes)]
-                per_device.append(jax.device_put(piece.buf, shard.device))
+                dst = (
+                    shard.device
+                    if memory_kind is None
+                    else jax.sharding.SingleDeviceSharding(
+                        shard.device, memory_kind=memory_kind
+                    )
+                )
+                per_device.append(jax.device_put(piece.buf, dst))
             self.fut.obj = jax.make_array_from_single_device_arrays(
                 global_shape, obj_out.sharding, per_device
             )

@@ -2,12 +2,20 @@
 
 The reference library ships example *training scripts* (DDP / FSDP /
 torchrec DLRM, SURVEY.md §2 #23-24) but no model code of its own. tpusnap
-ships one flagship decoder transformer whose parameter pytree exercises
-every sharding family the checkpoint preparers must handle — DP
-(replicated), FSDP (param-sharded), TP (tensor-parallel), SP/CP (ring
-attention over a sequence axis) and EP (expert-sharded MoE weights).
+ships two model families: a flagship decoder transformer whose parameter
+pytree exercises every sharding family the checkpoint preparers must
+handle — DP (replicated), FSDP (param-sharded), TP (tensor-parallel),
+SP/CP (ring attention over a sequence axis) and EP (expert-sharded MoE
+weights) — and a sharded embedding-table collection (the torchrec DMP
+analog: row/col/table-wise layouts, host-offloaded tables, row-wise
+Adagrad state).
 """
 
+from .embedding import (  # noqa: F401
+    EmbeddingCollection,
+    TableConfig,
+    make_embedding_train_step,
+)
 from .transformer import (  # noqa: F401
     Transformer,
     TransformerConfig,
@@ -15,4 +23,12 @@ from .transformer import (  # noqa: F401
     make_train_step,
 )
 
-__all__ = ["Transformer", "TransformerConfig", "make_mesh", "make_train_step"]
+__all__ = [
+    "EmbeddingCollection",
+    "TableConfig",
+    "Transformer",
+    "TransformerConfig",
+    "make_embedding_train_step",
+    "make_mesh",
+    "make_train_step",
+]

@@ -3,16 +3,41 @@ a TrainState) as a Stateful.
 
 tpusnap extension with no reference counterpart: the reference leans on
 torch modules implementing state_dict() themselves; JAX state is plain
-pytrees. ``state_dict`` exposes the tree as nested containers (dict/list/
-tuple — NamedTuples and custom pytree nodes flatten through
-``jax.tree_util``), and ``load_state_dict`` restores values while
-preserving the ORIGINAL tree structure, so NamedTuple/custom-node types
-survive the round-trip even though the snapshot stores generic containers.
+pytrees. ``state_dict`` exposes the tree as nested dicts keyed by the
+pytree *key path* (``jax.tree_util.tree_flatten_with_path``), so every
+leaf has a stable human-readable logical path in the snapshot manifest —
+``emb/tables/t0`` — addressable by ``Snapshot.read_object`` exactly like
+the reference's named state-dict entries. ``load_state_dict`` restores
+values by the same paths while preserving the ORIGINAL tree structure, so
+NamedTuple/custom-node types survive the round-trip even though the
+snapshot stores generic containers.
+
+(Snapshots written by the pre-named-path format — index-keyed
+``leaves/N`` entries — are not loadable by this class: the in-place
+restore machinery matches snapshot entries to target leaves by path, so
+an index-keyed snapshot would silently lose sharding/placement. The
+format changed before any release.)
 """
 
-from typing import Any, Dict
+from typing import Any, Dict, List, Tuple
 
 import jax
+
+
+def _segments(path: Tuple[Any, ...]) -> List[str]:
+    segs: List[str] = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            segs.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            segs.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            segs.append(k.name)
+        elif isinstance(k, jax.tree_util.FlattenedIndexKey):
+            segs.append(str(k.key))
+        else:  # future key types: fall back to their repr
+            segs.append(str(k))
+    return segs
 
 
 class PytreeState:
@@ -24,15 +49,46 @@ class PytreeState:
         return self._tree
 
     def state_dict(self) -> Dict[str, Any]:
-        leaves = jax.tree_util.tree_leaves(self._tree)
-        return {"leaves": leaves}
+        paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(
+            self._tree
+        )
+        if treedef.num_leaves == 1 and not paths_and_leaves[0][0]:
+            return {"value": paths_and_leaves[0][1]}  # bare-leaf tree
+        out: Dict[str, Any] = {}
+        for path, leaf in paths_and_leaves:
+            segs = _segments(path)
+            node = out
+            for seg in segs[:-1]:
+                node = node.setdefault(seg, {})
+                if not isinstance(node, dict):
+                    raise ValueError(
+                        f"pytree key path collision at {'/'.join(segs)!r}"
+                    )
+            if segs[-1] in node:
+                raise ValueError(
+                    f"pytree key paths collide after string conversion: "
+                    f"{'/'.join(segs)!r}"
+                )
+            node[segs[-1]] = leaf
+        return out
 
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
-        treedef = jax.tree_util.tree_structure(self._tree)
-        leaves = state_dict["leaves"]
-        if treedef.num_leaves != len(leaves):
-            raise ValueError(
-                f"Snapshot holds {len(leaves)} leaves but the target pytree "
-                f"has {treedef.num_leaves}"
-            )
+        paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(
+            self._tree
+        )
+
+        def lookup(path):
+            if not path:
+                return state_dict["value"]
+            node: Any = state_dict
+            segs = _segments(path)
+            for seg in segs:
+                if not isinstance(node, dict) or seg not in node:
+                    raise KeyError(
+                        f"snapshot is missing pytree path {'/'.join(segs)!r}"
+                    )
+                node = node[seg]
+            return node
+
+        leaves = [lookup(path) for path, _ in paths_and_leaves]
         self._tree = jax.tree_util.tree_unflatten(treedef, leaves)

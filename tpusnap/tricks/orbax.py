@@ -47,29 +47,46 @@ class PyTreeCheckpointer:
 
     def restore(self, directory: Any, item: Optional[Any] = None, **_: Any) -> Any:
         """Restore the saved pytree. With ``item`` (a target pytree of
-        arrays), leaves restore onto the targets' shardings/placements
-        and the original tree structure is preserved."""
+        arrays), leaves restore onto the targets' shardings/placements and
+        the original tree structure is preserved. Without a target, the
+        saved *structure* is rebuilt from the manifest (nested dicts keyed
+        by pytree key path — orbax's restore-without-args analog) with
+        host-resident leaves."""
         path = os.fspath(directory)
         if item is None:
             snapshot = Snapshot(path)
-            manifest = snapshot.get_manifest()
-            # Dedupe on the logical path: sharded entries appear once per
-            # rank under "<rank>/<logical_path>" keys (manifest_ops.py).
-            n_leaves = len(
-                {
-                    p.split("/", 1)[1]
-                    for p in manifest
-                    if p.split("/", 1)[1].startswith(f"{self._KEY}/leaves/")
-                }
-            )
-            # Int placeholders: None would be an *empty subtree* to
-            # jax.tree_util, leaving the target with zero leaves.
-            state = PytreeState([0] * n_leaves)
+            state = PytreeState(self._placeholder_tree(snapshot))
             snapshot.restore({self._KEY: state})
             return state.tree
         state = PytreeState(item)
         Snapshot(path).restore({self._KEY: state})
         return state.tree
+
+    def _placeholder_tree(self, snapshot: Snapshot) -> Any:
+        """Nested dict of int placeholders mirroring the saved pytree's
+        key paths (PytreeState's named state_dict layout). Placeholders
+        are ints, not None — None is an *empty subtree* to jax.tree_util
+        and would leave the target with zero leaves."""
+        from ..flatten import _decode
+        from ..manifest import is_container_entry
+
+        manifest = snapshot.get_manifest()
+        leaf_paths = set()
+        for p, entry in manifest.items():
+            parts = p.split("/", 1)
+            if len(parts) != 2 or is_container_entry(entry):
+                continue
+            rest = parts[1]
+            if rest.startswith(f"{self._KEY}/"):
+                leaf_paths.add(rest[len(self._KEY) + 1 :])
+        root: Any = {}
+        for lp in sorted(leaf_paths):
+            segs = [_decode(s) for s in lp.split("/")]
+            node = root
+            for seg in segs[:-1]:
+                node = node.setdefault(seg, {})
+            node[segs[-1]] = 0
+        return root
 
     @staticmethod
     def _remove_existing(path: str) -> None:
