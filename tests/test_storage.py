@@ -79,6 +79,16 @@ def test_fs_direct_io_roundtrip(tmp_path):
                 _native.write_file(path, memoryview(data))
                 with open(path, "rb") as f:
                     assert f.read() == data
+                # ranged reads: aligned, misaligned head/tail, past-EOF
+                for off, n in ((0, nbytes), (4096, 5 * 1024 * 1024),
+                               (1234, 4 * 1024 * 1024 + 77),
+                               (nbytes - 100, 500),
+                               # large request starting in the final
+                               # partial block: empty aligned window
+                               (nbytes - 3, 4 * 1024 * 1024)):
+                    out = bytearray(n)
+                    got = _native.read_range(path, off, n, out)
+                    assert bytes(out[:got]) == data[off:off + n]
 
 
 def test_fs_concurrent_writes(tmp_path):

@@ -60,3 +60,8 @@ class MemoryviewStream(io.IOBase):
 
     def getbuffer(self) -> Optional[memoryview]:
         return self._mv
+
+    def getvalue(self) -> bytes:
+        """BytesIO-compatible whole-buffer copy (ReadIO.buf consumers may
+        hold either type)."""
+        return bytes(self._mv)

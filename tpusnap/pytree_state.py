@@ -53,7 +53,9 @@ class PytreeState:
             self._tree
         )
         if treedef.num_leaves == 1 and not paths_and_leaves[0][0]:
-            return {"value": paths_and_leaves[0][1]}  # bare-leaf tree
+            # Bare-leaf tree: store under a sentinel key unlikely to
+            # collide with a real pytree dict key.
+            return {"__value__": paths_and_leaves[0][1]}
         out: Dict[str, Any] = {}
         for path, leaf in paths_and_leaves:
             segs = _segments(path)
@@ -79,7 +81,7 @@ class PytreeState:
 
         def lookup(path):
             if not path:
-                return state_dict["value"]
+                return state_dict["__value__"]
             node: Any = state_dict
             segs = _segments(path)
             for seg in segs:
@@ -88,6 +90,14 @@ class PytreeState:
                         f"snapshot is missing pytree path {'/'.join(segs)!r}"
                     )
                 node = node[seg]
+            if isinstance(node, dict):
+                # The snapshot's tree is deeper here than the target's —
+                # installing a container as a leaf would surface as a
+                # confusing failure far from the cause.
+                raise ValueError(
+                    f"snapshot holds a subtree at {'/'.join(segs)!r} where "
+                    "the target pytree has a leaf"
+                )
             return node
 
         leaves = [lookup(path) for path, _ in paths_and_leaves]

@@ -15,8 +15,10 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Set
 
 import aiofiles
+import numpy as np
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..memoryview_stream import MemoryviewStream
 
 # Buffers >= this go through the thread-pool native writer; small writes
 # stay on the aiofiles path where syscall overhead doesn't matter.
@@ -73,25 +75,18 @@ class FSStoragePlugin(StoragePlugin):
                 await f.seek(offset)
             read_io.buf = io.BytesIO(await f.read(n))
 
-    async def _native_read(self, path: str, offset: int, n: int) -> io.BytesIO:
+    async def _native_read(self, path: str, offset: int, n: int):
         """Single GIL-released pread in a thread (native helper), landing
-        directly in the BytesIO's own buffer — no second allocation/copy."""
+        in an *uninitialized* numpy buffer — preallocating via BytesIO
+        would zero-fill n bytes first, which measurably serializes the
+        read pipeline on multi-GB restores."""
         loop = asyncio.get_running_loop()
-        bio = io.BytesIO()
-        # Preallocate n bytes in place (truncate does not extend).
-        bio.seek(n - 1)
-        bio.write(b"\0")
-        view = bio.getbuffer()
-        try:
-            got = await loop.run_in_executor(
-                self._get_executor(), _read_range, path, offset, n, view
-            )
-        finally:
-            view.release()
-        if got != n:
-            bio.truncate(got)
-        bio.seek(0)
-        return bio
+        arr = np.empty(n, dtype=np.uint8)
+        got = await loop.run_in_executor(
+            self._get_executor(), _read_range, path, offset, n, arr.data
+        )
+        view = memoryview(arr)[:got] if got != n else memoryview(arr)
+        return MemoryviewStream(view)
 
     async def delete(self, path: str) -> None:
         full = os.path.join(self.root, path)

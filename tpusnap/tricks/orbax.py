@@ -79,6 +79,8 @@ class PyTreeCheckpointer:
             rest = parts[1]
             if rest.startswith(f"{self._KEY}/"):
                 leaf_paths.add(rest[len(self._KEY) + 1 :])
+        if leaf_paths == {"__value__"}:
+            return 0  # bare-leaf pytree (PytreeState's sentinel)
         root: Any = {}
         for lp in sorted(leaf_paths):
             segs = [_decode(s) for s in lp.split("/")]
