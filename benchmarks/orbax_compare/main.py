@@ -1,0 +1,103 @@
+"""Head-to-head vs orbax.checkpoint: save + restore a sharded train state.
+
+The reference benchmarks itself against the incumbent checkpoint path of
+its ecosystem (torch.save in benchmarks/ddp, DeepSpeed's native
+checkpoint in /root/reference/benchmarks/deepspeed_opt/main.py:27-128).
+The JAX ecosystem's incumbent is orbax.checkpoint, so this harness saves
+and restores the SAME mesh-sharded transformer train state through both
+frameworks and reports wall-clock for each.
+
+Run (8 virtual CPU devices):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/orbax_compare/main.py [--d-model 1024]
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+from tpusnap.test_utils import apply_platform_env
+
+apply_platform_env()
+
+import jax
+
+from tpusnap import PytreeState, Snapshot
+from tpusnap.models import Transformer, TransformerConfig, make_mesh
+from tpusnap.models.transformer import init_train_state
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--d-model", type=int, default=1024)
+    parser.add_argument("--n-layers", type=int, default=8)
+    args = parser.parse_args()
+
+    mesh = make_mesh()
+    cfg = TransformerConfig(
+        vocab_size=32768,
+        d_model=args.d_model,
+        n_heads=16,
+        n_layers=args.n_layers,
+        d_ff=4 * args.d_model,
+    )
+    model = Transformer(cfg)
+    state = init_train_state(model, mesh, jax.random.PRNGKey(0))
+    nbytes = sum(leaf.nbytes for leaf in jax.tree.leaves(state))
+    print(f"train state: {nbytes / 1e9:.2f} GB over mesh {dict(mesh.shape)}")
+
+    work = tempfile.mkdtemp(prefix="tpusnap_bench_orbax_")
+    try:
+        # --- tpusnap
+        t0 = time.perf_counter()
+        Snapshot.take(os.path.join(work, "tpusnap"), {"ts": PytreeState(state)})
+        ts_save = time.perf_counter() - t0
+        target = PytreeState(jax.tree.map(lambda x: x, state))
+        t0 = time.perf_counter()
+        Snapshot(os.path.join(work, "tpusnap")).restore({"ts": target})
+        ts_load = time.perf_counter() - t0
+        print(
+            f"tpusnap: save {ts_save:.2f}s ({nbytes / ts_save / 1e9:.2f} GB/s), "
+            f"restore {ts_load:.2f}s ({nbytes / ts_load / 1e9:.2f} GB/s)"
+        )
+
+        # --- orbax
+        import orbax.checkpoint as ocp
+
+        ckpt = ocp.PyTreeCheckpointer()
+        t0 = time.perf_counter()
+        ckpt.save(os.path.join(work, "orbax"), state)
+        ox_save = time.perf_counter() - t0
+        shardings = jax.tree.map(lambda x: x.sharding, state)
+        restore_args = jax.tree.map(
+            lambda s: ocp.ArrayRestoreArgs(sharding=s), shardings
+        )
+        t0 = time.perf_counter()
+        ckpt.restore(
+            os.path.join(work, "orbax"),
+            restore_args=ocp.args.PyTreeRestore(restore_args=restore_args)
+            if hasattr(ocp, "args")
+            else None,
+        )
+        ox_load = time.perf_counter() - t0
+        print(
+            f"orbax:   save {ox_save:.2f}s ({nbytes / ox_save / 1e9:.2f} GB/s), "
+            f"restore {ox_load:.2f}s ({nbytes / ox_load / 1e9:.2f} GB/s)"
+        )
+        print(
+            f"speedup: save {ox_save / ts_save:.2f}x, "
+            f"restore {ox_load / ts_load:.2f}x"
+        )
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -186,7 +186,13 @@ def read_range(path: str, offset: int, n: int, out) -> int:
         return 0
     from ..knobs import is_direct_io_disabled
 
-    fn = lib.ts_read_range if is_direct_io_disabled() else lib.ts_read_range_direct
+    # Direct reads only pay off for large streams: many concurrent small
+    # direct reads thrash the device queue (each 8 MiB chunk is a
+    # synchronous round trip with no readahead) and measurably lose to
+    # buffered reads + POSIX_FADV_SEQUENTIAL. 64 MiB is past the
+    # crossover on the measured virtio/NVMe configs.
+    use_direct = n >= (64 << 20) and not is_direct_io_disabled()
+    fn = lib.ts_read_range_direct if use_direct else lib.ts_read_range
     ptr, keepalive = _ptr(mv)
     got = fn(path.encode(), ptr, offset, n)
     del keepalive

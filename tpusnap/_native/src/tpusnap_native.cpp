@@ -70,6 +70,12 @@ int ts_write_file_direct(const char* path, const void* buf, size_t n) {
   if (O_DIRECT == 0 || n < (4u << 20)) return ts_write_file(path, buf, n);
   int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC | O_DIRECT, 0644);
   if (fd < 0) return ts_write_file(path, buf, n);
+#ifdef __linux__
+  // Reserve the full extent up front: without this, concurrent direct
+  // writers allocate blocks chunk-by-chunk and interleave their extents,
+  // which turns later sequential restore reads into seek storms.
+  ::posix_fallocate(fd, 0, static_cast<off_t>(n));
+#endif
 
   const size_t aligned_n = n & ~(kAlign - 1);
   void* bounce[2] = {nullptr, nullptr};

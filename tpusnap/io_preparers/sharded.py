@@ -222,12 +222,31 @@ def _overlap(
 
 class _Piece:
     """One distinct piece of the restore target (a shard index of the
-    target sharding, or the whole array for dense targets)."""
+    target sharding, or the whole array for dense targets).
+
+    The backing buffer is lazy: when a saved shard exactly matches this
+    piece (same-sharding restore — the common production case) the read
+    buffer is *adopted* zero-copy via ``adopt`` and no allocation or
+    scatter copy happens at all. Saved shards are disjoint, so an exact
+    match is the piece's sole writer."""
 
     def __init__(self, offsets: List[int], sizes: List[int], np_dtype) -> None:
         self.offsets = offsets
         self.sizes = sizes
-        self.buf = np.empty(sizes, dtype=np_dtype)
+        self._np_dtype = np_dtype
+        self._buf: Optional[np.ndarray] = None
+
+    @property
+    def buf(self) -> np.ndarray:
+        if self._buf is None:
+            self._buf = np.empty(self.sizes, dtype=self._np_dtype)
+        return self._buf
+
+    def adopt(self, arr: np.ndarray) -> bool:
+        if self._buf is None:
+            self._buf = arr
+            return True
+        return False
 
 
 class _Assembler:
@@ -270,21 +289,24 @@ class _Assembler:
         obj_out = self.obj_out
         if isinstance(obj_out, jax.Array):
             global_shape = tuple(self.entry.shape)
-            per_device = []
+            bufs, dsts = [], []
             # Preserve the target's memory kind: a host-offloaded (UVM
             # analog) target must get pinned_host buffers, not HBM ones.
             memory_kind = getattr(obj_out.sharding, "memory_kind", None)
             for shard in obj_out.addressable_shards:
                 offsets, sizes = _index_to_box(shard.index, list(global_shape))
                 piece = self._piece_by_key[tuple(offsets) + tuple(sizes)]
-                dst = (
-                    shard.device
-                    if memory_kind is None
-                    else jax.sharding.SingleDeviceSharding(
+                bufs.append(piece.buf)
+                dsts.append(
+                    jax.sharding.SingleDeviceSharding(
                         shard.device, memory_kind=memory_kind
                     )
+                    if memory_kind is not None
+                    else shard.device
                 )
-                per_device.append(jax.device_put(piece.buf, dst))
+            # One batched transfer for all of this array's shards (a
+            # per-shard loop pays jax dispatch overhead per piece).
+            per_device = jax.device_put(bufs, dsts)
             self.fut.obj = jax.make_array_from_single_device_arrays(
                 global_shape, obj_out.sharding, per_device
             )
@@ -333,6 +355,14 @@ class _ScatterConsumer(BufferConsumer):
             memoryview(buf), self.saved.tensor.dtype, self.saved.sizes
         )
         for piece, (off, sz) in self.overlaps:
+            if (
+                list(off) == list(self.saved.offsets)
+                and list(sz) == list(self.saved.sizes)
+                and list(off) == list(piece.offsets)
+                and list(sz) == list(piece.sizes)
+                and piece.adopt(saved_arr)
+            ):
+                continue  # exact match: zero-copy, no scatter
             src_slices = tuple(
                 slice(off[d] - self.saved.offsets[d], off[d] - self.saved.offsets[d] + sz[d])
                 for d in range(len(off))

@@ -78,13 +78,18 @@ class FSStoragePlugin(StoragePlugin):
     async def _native_read(self, path: str, offset: int, n: int):
         """Single GIL-released pread in a thread (native helper), landing
         in an *uninitialized* numpy buffer — preallocating via BytesIO
-        would zero-fill n bytes first, which measurably serializes the
-        read pipeline on multi-GB restores."""
+        would zero-fill n bytes first. The allocation itself also happens
+        on the worker thread: large np.empty calls contend on the
+        process's mmap lock under concurrent read page-fault traffic and
+        would stall the event loop for tens of ms each."""
         loop = asyncio.get_running_loop()
-        arr = np.empty(n, dtype=np.uint8)
-        got = await loop.run_in_executor(
-            self._get_executor(), _read_range, path, offset, n, arr.data
-        )
+
+        def work():
+            arr = np.empty(n, dtype=np.uint8)
+            got = _read_range(path, offset, n, arr.data)
+            return arr, got
+
+        arr, got = await loop.run_in_executor(self._get_executor(), work)
         view = memoryview(arr)[:got] if got != n else memoryview(arr)
         return MemoryviewStream(view)
 
