@@ -71,8 +71,7 @@ def main() -> None:
         grads = grad_fn(state["params"], x, y)
         updates, new_opt = tx.update(grads, state["opt"])
         new_params = optax.apply_updates(state["params"], updates)
-        train.load_state_dict({"leaves": jax.tree_util.tree_leaves(
-            {"params": new_params, "opt": new_opt})})
+        train.tree = {"params": new_params, "opt": new_opt}
         progress["epoch"] += 1
 
         snap_path = f"{work_dir}/epoch_{progress['epoch']}"

@@ -84,9 +84,7 @@ def main() -> None:
                 token_sharding,
             )
             state, loss = train_step(state, tokens)
-        train.load_state_dict(
-            {"leaves": jax.tree_util.tree_leaves(state)}
-        )
+        train.tree = state
         progress["epoch"] += 1
 
         if pending is not None:

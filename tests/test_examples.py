@@ -1,0 +1,57 @@
+"""Smoke-run the examples as subprocesses — the examples are the canonical
+user journeys (reference examples/simple_example.py etc.); an API drift that
+breaks them must fail the suite, not a user.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name, *args, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name), *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, (
+        f"{name} failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    return proc.stdout
+
+
+def test_simple_example_and_resume(tmp_path):
+    out = _run_example("simple_example.py", "--work-dir", str(tmp_path))
+    assert "epoch 4" in out
+    # Resume from epoch 2's snapshot: the loop must continue at epoch 3.
+    out = _run_example(
+        "simple_example.py",
+        "--work-dir",
+        str(tmp_path),
+        "--resume-from",
+        str(tmp_path / "epoch_2"),
+    )
+    assert "resumed" in out and "at epoch 2" in out
+    assert "epoch 3" in out and "epoch 4" in out
+
+
+def test_transformer_example(tmp_path):
+    _run_example("transformer_example.py", "--work-dir", str(tmp_path))
+
+
+@pytest.mark.distributed
+def test_distributed_example(tmp_path):
+    _run_example("distributed_example.py", "--work-dir", str(tmp_path))

@@ -32,6 +32,12 @@ _lock = threading.Lock()
 
 
 def _build() -> bool:
+    # Link to a temp path, then rename into place: the final .so may
+    # already be dlopen-mapped (by this or another process), and letting
+    # the linker truncate a live mapping corrupts it. os.replace gives the
+    # new build a fresh inode, so a subsequent CDLL(_SO) maps the new
+    # library instead of returning glibc's cached handle for the old one.
+    tmp = f"{_SO}.tmp.{os.getpid()}"
     cmd = [
         "g++",
         "-O3",
@@ -41,14 +47,19 @@ def _build() -> bool:
         "-pthread",
         "-std=c++17",
         "-o",
-        _SO,
+        tmp,
         _SRC,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except Exception as e:  # toolchain missing/failed: fall back to Python
         logger.warning("tpusnap native build failed (%s); using Python fallbacks", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -65,50 +76,81 @@ def _load() -> Optional[ctypes.CDLL]:
         stale = not os.path.exists(_SO) or (
             os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
         )
-        if stale and not _build():
-            return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError as e:
-            logger.warning("tpusnap native load failed (%s)", e)
-            return None
-        lib.ts_write_file.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_void_p,
-            ctypes.c_size_t,
-        ]
-        lib.ts_write_file.restype = ctypes.c_int
-        lib.ts_write_file_direct.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_void_p,
-            ctypes.c_size_t,
-        ]
-        lib.ts_write_file_direct.restype = ctypes.c_int
-        lib.ts_read_range.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_void_p,
-            ctypes.c_int64,
-            ctypes.c_size_t,
-        ]
-        lib.ts_read_range.restype = ctypes.c_int64
-        lib.ts_read_range_direct.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_void_p,
-            ctypes.c_int64,
-            ctypes.c_size_t,
-        ]
-        lib.ts_read_range_direct.restype = ctypes.c_int64
-        lib.ts_memcpy_par.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_void_p,
-            ctypes.c_size_t,
-            ctypes.c_int,
-        ]
-        lib.ts_memcpy_par.restype = None
-        lib.ts_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32]
-        lib.ts_crc32c.restype = ctypes.c_uint32
-        _lib = lib
-        return _lib
+        built = False
+        if stale:
+            if not _build():
+                return None
+            built = True
+        # A cached .so from an older source revision can pass the mtime
+        # check (cp/checkout preserve equal mtimes) yet lack newer symbols.
+        # On missing symbols, rebuild once and retry — unless this .so was
+        # just built from current source, where a second identical build
+        # cannot help and the Python fallbacks are the only option.
+        for _ in range(2):
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError as e:
+                logger.warning("tpusnap native load failed (%s)", e)
+                return None
+            try:
+                _bind(lib)
+            except AttributeError as e:
+                if built:
+                    logger.warning(
+                        "tpusnap native .so is missing expected symbols "
+                        "(%s); using Python fallbacks",
+                        e,
+                    )
+                    return None
+                logger.warning(
+                    "tpusnap native .so is missing expected symbols; "
+                    "rebuilding"
+                )
+                if not _build():
+                    return None
+                built = True
+                continue
+            _lib = lib
+            return _lib
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.ts_write_file.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    lib.ts_write_file.restype = ctypes.c_int
+    lib.ts_write_file_direct.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    lib.ts_write_file_direct.restype = ctypes.c_int
+    lib.ts_read_range.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_size_t,
+    ]
+    lib.ts_read_range.restype = ctypes.c_int64
+    lib.ts_read_range_direct.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_size_t,
+    ]
+    lib.ts_read_range_direct.restype = ctypes.c_int64
+    lib.ts_memcpy_par.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int,
+    ]
+    lib.ts_memcpy_par.restype = None
+    lib.ts_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32]
+    lib.ts_crc32c.restype = ctypes.c_uint32
 
 
 def available() -> bool:

@@ -74,7 +74,17 @@ int ts_write_file_direct(const char* path, const void* buf, size_t n) {
   // Reserve the full extent up front: without this, concurrent direct
   // writers allocate blocks chunk-by-chunk and interleave their extents,
   // which turns later sequential restore reads into seek storms.
-  ::posix_fallocate(fd, 0, static_cast<off_t>(n));
+  // posix_fallocate returns the error number directly (not via errno).
+  // ENOSPC must fail now: letting the write proceed surfaces the failure
+  // later and then masks it behind a full buffered rewrite of a possibly
+  // multi-GB file. Other errors (EOPNOTSUPP on odd filesystems) are
+  // non-fatal — the writes below allocate blocks themselves.
+  int fa = ::posix_fallocate(fd, 0, static_cast<off_t>(n));
+  if (fa == ENOSPC) {
+    ::close(fd);
+    ::unlink(path);
+    return -ENOSPC;
+  }
 #endif
 
   const size_t aligned_n = n & ~(kAlign - 1);
@@ -118,13 +128,23 @@ int ts_write_file_direct(const char* path, const void* buf, size_t n) {
   std::free(bounce[0]);
   std::free(bounce[1]);
   ::close(fd);
+  if (werr.load() == ENOSPC) {
+    // A full disk won't be cured by a buffered rewrite of the same bytes
+    // — fail now instead of doubling the multi-GB I/O on the error path
+    // (reachable when posix_fallocate was unsupported, e.g. FUSE).
+    ::unlink(path);
+    return -ENOSPC;
+  }
   if (werr.load()) {
     // Write-phase failure. This covers filesystems/devices that accept
     // O_DIRECT at open() but reject the I/O (logical block size > kAlign,
     // FUSE quirks) and short writes that left the continuation offset
     // unaligned (EINVAL masking the true cause, e.g. a filling disk). A
-    // buffered rewrite either succeeds or reports the real errno.
-    return ts_write_file(path, buf, n);
+    // buffered rewrite either succeeds or reports the real errno; when it
+    // fails too (disk genuinely full), don't leave a partial blob behind.
+    int rc = ts_write_file(path, buf, n);
+    if (rc != 0) ::unlink(path);
+    return rc;
   }
 
   // Unaligned tail: a buffered positional write (offset need not be

@@ -188,7 +188,7 @@ def get_communicator(comm: Optional[Communicator] = None) -> Communicator:
     if client is not None and nproc > 1:
         return JaxCoordinationComm()
 
-    if client is None and _backend_initialized():
+    if client is None and _backend_initialized() is not False:
         # Some multi-host deployments (libtpu auto-bootstrap on TPU pods)
         # never call jax.distributed.initialize, so there is no
         # coordination client to ride. A device backend is already live
@@ -196,7 +196,9 @@ def get_communicator(comm: Optional[Communicator] = None) -> Communicator:
         # costs no new backend init — and a >1 answer with no client means
         # snapshots would collide: fail loudly. With no backend
         # initialized we stay backend-free and treat the process as
-        # single-process.
+        # single-process. "Unknown" (the probe itself broke) must run the
+        # loud check too: assuming single-process here is the silent
+        # snapshot-collision corruption mode.
         import jax
 
         if jax.process_count() > 1:
@@ -210,12 +212,24 @@ def get_communicator(comm: Optional[Communicator] = None) -> Communicator:
     return Communicator()
 
 
-def _backend_initialized() -> bool:
-    """True when some XLA backend is already live in this process —
-    checked without triggering initialization."""
+def _backend_initialized() -> Optional[bool]:
+    """Whether some XLA backend is already live in this process, checked
+    without triggering initialization. Returns None when the private probe
+    is unavailable (jax._src.xla_bridge moved): the caller must then fall
+    back to the loud public-API check instead of assuming single-process —
+    a silent False here is exactly the multi-host snapshot-collision mode
+    this module is designed to fail loudly on."""
     try:
         from jax._src import xla_bridge as _xb
-
+    except Exception:
+        logger.warning(
+            "tpusnap cannot probe jax._src.xla_bridge on this JAX version; "
+            "falling back to jax.process_count() to rule out an "
+            "uncoordinated multi-host job (this may initialize the device "
+            "backend)."
+        )
+        return None
+    try:
         return bool(getattr(_xb, "_backends", None))
     except Exception:
-        return False
+        return None

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import math
+import threading
 from concurrent.futures import Executor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -228,25 +229,37 @@ class _Piece:
     piece (same-sharding restore — the common production case) the read
     buffer is *adopted* zero-copy via ``adopt`` and no allocation or
     scatter copy happens at all. Saved shards are disjoint, so an exact
-    match is the piece's sole writer."""
+    match is the piece's sole writer.
+
+    Allocation/adoption is guarded by a lock: ``_scatter`` runs on a
+    multi-worker executor, and when a piece overlaps several saved shards
+    (resharding restores) two threads may race to allocate. Concurrent
+    scatters into an allocated buffer are safe without the lock — saved
+    shards are disjoint, so the written regions never overlap."""
 
     def __init__(self, offsets: List[int], sizes: List[int], np_dtype) -> None:
         self.offsets = offsets
         self.sizes = sizes
         self._np_dtype = np_dtype
         self._buf: Optional[np.ndarray] = None
+        self._alloc_lock = threading.Lock()
 
     @property
     def buf(self) -> np.ndarray:
-        if self._buf is None:
-            self._buf = np.empty(self.sizes, dtype=self._np_dtype)
-        return self._buf
+        buf = self._buf
+        if buf is None:
+            with self._alloc_lock:
+                if self._buf is None:
+                    self._buf = np.empty(self.sizes, dtype=self._np_dtype)
+                buf = self._buf
+        return buf
 
     def adopt(self, arr: np.ndarray) -> bool:
-        if self._buf is None:
-            self._buf = arr
-            return True
-        return False
+        with self._alloc_lock:
+            if self._buf is None:
+                self._buf = arr
+                return True
+            return False
 
 
 class _Assembler:

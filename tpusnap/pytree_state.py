@@ -48,6 +48,10 @@ class PytreeState:
     def tree(self) -> Any:
         return self._tree
 
+    @tree.setter
+    def tree(self, new_tree: Any) -> None:
+        self._tree = new_tree
+
     def state_dict(self) -> Dict[str, Any]:
         paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(
             self._tree
