@@ -1,10 +1,30 @@
-"""Headline benchmark: Snapshot.take throughput to local FS.
+"""Headline benchmark: Snapshot.take throughput to local FS, decomposed.
 
 Mirrors the reference's published benchmark (single-accelerator DDP take
 to local FS, /root/reference/benchmarks/ddp/README.md:17 — 20 GB in
 ~13.91 s ≈ 1.438 GB/s on one A100; DtoH over PCIe is not the bottleneck
 there, storage I/O is). ``vs_baseline`` is the throughput ratio against
 that 1.438 GB/s.
+
+Besides the headline number the JSON carries a decomposition so the
+result is interpretable on any disk:
+- ``roofline_gbps``: in-harness write roofline — the same 16-file layout
+  written as raw streams through the SAME native write engine (same
+  buffer-alignment class as user state arrays, so the same
+  RWF_DONTCACHE/O_DIRECT routing), same thread pool, zero snapshot
+  machinery on top. It is the fastest this byte layout can move with the
+  take's own engine and durability semantics, so ``roofline_fraction``
+  (take / roofline) reads directly as pipeline efficiency; values near
+  (or, under disk-bandwidth swings between the interleaved samples,
+  slightly above) 1.0 mean the pipeline adds nothing.
+- The A100 baseline machine's local NVMe sustains multi-GB/s; this VM's
+  virtio disk measures ~1-2 GB/s and swings >2x minute to minute
+  (single-stream plain-buffered writes are host-throttled to ~0.2 GB/s),
+  so the fraction — not the absolute number — is the portable verdict
+  on the pipeline.
+- ``staging_s`` / ``residual_io_s``: the scheduler's split of the best
+  take (staging = the window training would be blocked in async_take).
+- ``restore_gbps``: cold-cache restore throughput of the same snapshot.
 
 The state is **host-resident** (numpy): this benchmark measures the
 framework pipeline — zero-copy serialization, budget-gated scheduling,
@@ -15,7 +35,7 @@ tens of GB/s), so including a device transfer would only measure the
 tunnel. Device-array staging (async DtoH enqueued at prepare time,
 overlapped with I/O) is exercised by tests/test_snapshot.py instead.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
@@ -24,6 +44,7 @@ import shutil
 import sys
 import tempfile
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -32,10 +53,58 @@ BASELINE_GBPS = 20.0 / 13.91
 
 TOTAL_BYTES = int(os.environ.get("TPUSNAP_BENCH_BYTES", 2 * 1024**3))
 N_ARRAYS = 16
+N_TAKE_RUNS = int(os.environ.get("TPUSNAP_BENCH_RUNS", 4))
+
+
+def _drop_caches() -> bool:
+    try:
+        os.sync()
+        with open("/proc/sys/vm/drop_caches", "w") as f:
+            f.write("3")
+        return True
+    except OSError:
+        return False
+
+
+def measure_roofline(tmp: str, nbytes_per_file: int, n_files: int) -> float:
+    """Raw aggregate write throughput for the snapshot's exact file
+    layout: same native write engine, same 8-worker pool the fs plugin
+    uses, same buffer alignment class as user state arrays (numpy
+    allocations are not page-aligned), no snapshot machinery on top. This
+    is the fastest any checkpoint writer could move these bytes with
+    these durability semantics."""
+    from tpusnap import _native as native
+
+    # +16 offset: match the alignment class of numpy-owned state arrays
+    # so the roofline exercises the same engine the take's writes do.
+    buf = native.aligned_empty(nbytes_per_file + 16)[16:]
+    # Random payload: constant fill could be flattered by host-side
+    # image compression and would not match what the take writes.
+    buf[:] = np.random.default_rng(1).integers(
+        0, 255, nbytes_per_file, dtype=np.uint8
+    )
+    best = 0.0
+    for _ in range(2):
+        os.sync()
+        ex = ThreadPoolExecutor(max_workers=8)
+        t0 = time.perf_counter()
+        list(
+            ex.map(
+                lambda i: native.write_file(os.path.join(tmp, f"r{i}"), buf),
+                range(n_files),
+            )
+        )
+        el = time.perf_counter() - t0
+        ex.shutdown()
+        for i in range(n_files):
+            os.unlink(os.path.join(tmp, f"r{i}"))
+        best = max(best, nbytes_per_file * n_files / el / 1e9)
+    return best
 
 
 def main() -> None:
     from tpusnap import PytreeState, Snapshot
+    from tpusnap import scheduler as _sched
 
     per_array = TOTAL_BYTES // N_ARRAYS
     rng = np.random.default_rng(0)
@@ -47,10 +116,53 @@ def main() -> None:
     }
     nbytes = sum(a.nbytes for a in state.values())
 
-    times = []
-    for _ in range(3):
-        tmp = tempfile.mkdtemp(prefix="tpusnap_bench_")
-        try:
+    bench_root = tempfile.mkdtemp(prefix="tpusnap_bench_")
+    try:
+        # Restore first, from a single settled snapshot: the bench writes
+        # ~20 GB overall, and the host keeps flushing guest writes for
+        # many seconds after the guest's own sync returns — cold reads
+        # measured in that window only show the host's writeback, not the
+        # restore path.
+        restore_snap = os.path.join(bench_root, "restore_src", "snap")
+        Snapshot.take(restore_snap, {"model": PytreeState(state)})
+        os.sync()
+        time.sleep(4.0)
+        restore_runs = []
+        for _ in range(2):
+            cold = _drop_caches()
+            target = {
+                f"w{i}": np.empty_like(state[f"w{i}"]) for i in range(N_ARRAYS)
+            }
+            app_state = {"model": PytreeState(target)}
+            t0 = time.perf_counter()
+            Snapshot(restore_snap).restore(app_state)
+            restore_runs.append(time.perf_counter() - t0)
+        restore_el = min(restore_runs)
+        restore_gbps = nbytes / restore_el / 1e9
+        # Bit-pattern comparison: random f16 buffers contain NaNs, and
+        # NaN != NaN would fail a value comparison on correct data.
+        ok = all(
+            np.array_equal(
+                app_state["model"].tree[f"w{i}"].view(np.uint16),
+                state[f"w{i}"].view(np.uint16),
+            )
+            for i in (0, N_ARRAYS - 1)
+        )
+        del target, app_state
+        shutil.rmtree(os.path.join(bench_root, "restore_src"), ignore_errors=True)
+
+        # The virtio disk's bandwidth swings >2x on multi-second timescales
+        # (host contention), so roofline and take are sampled INTERLEAVED —
+        # comparing a lucky roofline window against an unlucky take window
+        # would say "pipeline overhead" where there is only disk noise.
+        times = []
+        splits = []
+        rooflines = []
+        for run in range(N_TAKE_RUNS):
+            rooflines.append(
+                measure_roofline(bench_root, per_array, N_ARRAYS)
+            )
+            tmp = os.path.join(bench_root, f"take{run}")
             app_state = {"model": PytreeState(state)}
             # Drain pending page-cache writeback from earlier iterations so
             # each timed take competes only with its own I/O.
@@ -58,10 +170,19 @@ def main() -> None:
             t0 = time.perf_counter()
             Snapshot.take(os.path.join(tmp, "snap"), app_state)
             times.append(time.perf_counter() - t0)
-        finally:
+            stats = _sched.LAST_EXECUTION_STATS.get("write", {})
+            splits.append(
+                (stats.get("staging_s"), stats.get("total_s"))
+            )
             shutil.rmtree(tmp, ignore_errors=True)
-    best = min(times)
-    gbps = nbytes / best / 1e9
+        best_i = min(range(len(times)), key=times.__getitem__)
+        best = times[best_i]
+        gbps = nbytes / best / 1e9
+        staging_s, sched_total_s = splits[best_i]
+        roofline = max(rooflines)
+    finally:
+        shutil.rmtree(bench_root, ignore_errors=True)
+
     print(
         json.dumps(
             {
@@ -69,6 +190,19 @@ def main() -> None:
                 "value": round(gbps, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+                "roofline_gbps": round(roofline, 3),
+                "roofline_fraction": round(gbps / roofline, 3),
+                "roofline_runs_gbps": [round(r, 3) for r in rooflines],
+                "take_runs_s": [round(t, 2) for t in times],
+                "staging_s": round(staging_s, 2) if staging_s else None,
+                "residual_io_s": (
+                    round(sched_total_s - staging_s, 2)
+                    if staging_s and sched_total_s
+                    else None
+                ),
+                "restore_gbps": round(restore_gbps, 3),
+                "restore_cold_cache": cold,
+                "restore_verified": ok,
             }
         )
     )

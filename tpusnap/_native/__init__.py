@@ -128,6 +128,23 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_size_t,
     ]
     lib.ts_write_file_direct.restype = ctypes.c_int
+    lib.ts_write_file_direct2.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.c_size_t,
+    ]
+    lib.ts_write_file_direct2.restype = ctypes.c_int
+    lib.ts_write_file_auto.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.c_size_t,
+        ctypes.c_int,
+    ]
+    lib.ts_write_file_auto.restype = ctypes.c_int
     lib.ts_read_range.argtypes = [
         ctypes.c_char_p,
         ctypes.c_void_p,
@@ -142,6 +159,15 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_size_t,
     ]
     lib.ts_read_range_direct.restype = ctypes.c_int64
+    lib.ts_read_range_direct2.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.c_size_t,
+    ]
+    lib.ts_read_range_direct2.restype = ctypes.c_int64
     lib.ts_memcpy_par.argtypes = [
         ctypes.c_void_p,
         ctypes.c_void_p,
@@ -167,6 +193,18 @@ def _ptr(buf) -> Tuple[int, np.ndarray]:
     return arr.ctypes.data, arr
 
 
+def aligned_empty(nbytes: int, align: int = 4096) -> np.ndarray:
+    """Uninitialized uint8 buffer whose data pointer is ``align``-aligned.
+
+    Buffers tpusnap allocates itself (batcher slabs, async-snapshot
+    clones, staged copies) are aligned so the O_DIRECT writer can pwrite
+    straight from them — the zero-copy branch of ts_write_file_direct2 —
+    instead of bouncing every chunk through an aligned copy."""
+    raw = np.empty(nbytes + align, dtype=np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off : off + nbytes]
+
+
 def write_file(path: str, buf) -> None:
     """Whole-buffer file write with the GIL released for the full transfer.
 
@@ -182,11 +220,25 @@ def write_file(path: str, buf) -> None:
     if mv.nbytes == 0:
         open(path, "wb").close()
         return
-    from ..knobs import is_direct_io_disabled
+    from ..knobs import (
+        get_direct_io_chunk_bytes,
+        get_direct_io_qd,
+        is_direct_io_disabled,
+        is_dontcache_disabled,
+    )
 
-    fn = lib.ts_write_file if is_direct_io_disabled() else lib.ts_write_file_direct
     ptr, keepalive = _ptr(mv)
-    rc = fn(path.encode(), ptr, mv.nbytes)
+    if is_direct_io_disabled():
+        rc = lib.ts_write_file(path.encode(), ptr, mv.nbytes)
+    else:
+        rc = lib.ts_write_file_auto(
+            path.encode(),
+            ptr,
+            mv.nbytes,
+            get_direct_io_qd(),
+            get_direct_io_chunk_bytes(),
+            0 if is_dontcache_disabled() else 1,
+        )
     del keepalive
     if rc != 0:
         raise OSError(-rc, os.strerror(-rc), path)
@@ -226,17 +278,31 @@ def read_range(path: str, offset: int, n: int, out) -> int:
         return len(data)
     if n == 0:
         return 0
-    from ..knobs import is_direct_io_disabled
+    from ..knobs import (
+        get_direct_io_chunk_bytes,
+        get_direct_io_qd,
+        is_direct_io_disabled,
+    )
 
     # Direct reads only pay off for large streams: many concurrent small
-    # direct reads thrash the device queue (each 8 MiB chunk is a
-    # synchronous round trip with no readahead) and measurably lose to
-    # buffered reads + POSIX_FADV_SEQUENTIAL. 64 MiB is past the
-    # crossover on the measured virtio/NVMe configs.
+    # direct reads thrash the device queue (each chunk is a synchronous
+    # round trip with no readahead) and measurably lose to buffered reads
+    # + POSIX_FADV_SEQUENTIAL. 64 MiB is past the crossover on the
+    # measured virtio/NVMe configs. Aligned destinations (fs-plugin read
+    # buffers are) take the zero-copy pread path inside direct2.
     use_direct = n >= (64 << 20) and not is_direct_io_disabled()
-    fn = lib.ts_read_range_direct if use_direct else lib.ts_read_range
     ptr, keepalive = _ptr(mv)
-    got = fn(path.encode(), ptr, offset, n)
+    if use_direct:
+        got = lib.ts_read_range_direct2(
+            path.encode(),
+            ptr,
+            offset,
+            n,
+            get_direct_io_qd(),
+            get_direct_io_chunk_bytes(),
+        )
+    else:
+        got = lib.ts_read_range(path.encode(), ptr, offset, n)
     del keepalive
     if got < 0:
         raise OSError(-got, os.strerror(-got), path)

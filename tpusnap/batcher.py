@@ -19,6 +19,8 @@ import uuid
 from concurrent.futures import Executor
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .io_types import (
     BufferConsumer,
     BufferStager,
@@ -62,7 +64,10 @@ class BatchedBufferStager(BufferStager):
         self.total = sum(n for _, n, _ in members)
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
-        slab = bytearray(self.total)
+        from . import _native
+
+        # Aligned so the O_DIRECT writer pwrites straight from the slab.
+        slab = _native.aligned_empty(self.total)
 
         async def fill(offset: int, nbytes: int, stager: BufferStager) -> None:
             buf = await stager.stage_buffer(executor)
@@ -71,7 +76,7 @@ class BatchedBufferStager(BufferStager):
                 raise RuntimeError(
                     f"Batched member staged {mv.nbytes} bytes, expected {nbytes}"
                 )
-            slab[offset : offset + nbytes] = mv
+            slab[offset : offset + nbytes] = np.frombuffer(mv, dtype=np.uint8)
 
         await asyncio.gather(*(fill(o, n, s) for o, n, s in self.members))
         return slab

@@ -97,7 +97,7 @@ class ArrayBufferStager(BufferStager):
             # memcpy releases the GIL (and parallelizes) for large clones.
             from .. import _native
 
-            out = bytearray(mv.nbytes)
+            out = _native.aligned_empty(mv.nbytes)
             _native.memcpy(out, mv)
             return out
         return mv

@@ -21,10 +21,17 @@ _DISABLE_PARTITIONER_ENV_VAR = "TPUSNAP_DISABLE_PARTITIONER"
 _MEMORY_BUDGET_ENV_VAR = "TPUSNAP_MAX_PER_RANK_MEMORY_BUDGET_BYTES"
 _DISABLE_NATIVE_ENV_VAR = "TPUSNAP_DISABLE_NATIVE"
 _DISABLE_DIRECT_IO_ENV_VAR = "TPUSNAP_DISABLE_DIRECT_IO"
+_DISABLE_DONTCACHE_ENV_VAR = "TPUSNAP_DISABLE_DONTCACHE"
+_DIRECT_IO_QD_ENV_VAR = "TPUSNAP_DIRECT_IO_QD"
+_DIRECT_IO_CHUNK_ENV_VAR = "TPUSNAP_DIRECT_IO_CHUNK_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES = 128 * 1024 * 1024
+# Per-file O_DIRECT write queue depth / chunk size (measured on virtio:
+# QD 2 x 32 MiB out-runs single-in-flight 8 MiB by ~30% aggregate).
+_DEFAULT_DIRECT_IO_QD = 2
+_DEFAULT_DIRECT_IO_CHUNK_BYTES = 32 * 1024 * 1024
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -73,6 +80,24 @@ def is_direct_io_disabled() -> bool:
     falls back to buffered writes automatically on filesystems without
     O_DIRECT support, so this knob exists for debugging/bench A-Bs."""
     return os.environ.get(_DISABLE_DIRECT_IO_ENV_VAR, "0") == "1"
+
+
+def is_dontcache_disabled() -> bool:
+    """Uncached buffered writes (RWF_DONTCACHE, Linux 6.14+) for
+    unaligned sources: on by default; the native layer falls back to the
+    O_DIRECT bounce pipeline automatically where unsupported."""
+    return os.environ.get(_DISABLE_DONTCACHE_ENV_VAR, "0") == "1"
+
+
+def get_direct_io_qd() -> int:
+    """In-flight chunk writes per file on the O_DIRECT path."""
+    return _get_int_env(_DIRECT_IO_QD_ENV_VAR, _DEFAULT_DIRECT_IO_QD)
+
+
+def get_direct_io_chunk_bytes() -> int:
+    return _get_int_env(
+        _DIRECT_IO_CHUNK_ENV_VAR, _DEFAULT_DIRECT_IO_CHUNK_BYTES
+    )
 
 
 def get_memory_budget_override_bytes() -> Optional[int]:

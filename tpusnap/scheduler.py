@@ -74,8 +74,17 @@ async def _cancel_and_drain(tasks: Set[asyncio.Task]) -> None:
         await asyncio.gather(*tasks, return_exceptions=True)
 
 
+# Stats of the most recent completed write/read execution in this process,
+# keyed by verb ("write"/"read"). Benchmarks and tests read this to get the
+# staging-time vs total-time split without parsing logs.
+LAST_EXECUTION_STATS: dict = {}
+
+
 class _Reporter:
-    """Periodic pipeline progress logging (reference scheduler.py:96-175)."""
+    """Periodic pipeline progress logging (reference scheduler.py:96-175):
+    per-stage pipeline counts, RSS delta, remaining memory budget, and a
+    staging-time vs total-time summary — the observability needed to tell
+    a staging-bound pipeline from an I/O-bound one."""
 
     def __init__(self, rank: int, verb: str, total_reqs: int) -> None:
         self.rank = rank
@@ -86,6 +95,16 @@ class _Reporter:
         self.bytes_done = 0
         self.reqs_done = 0
         self.rss_begin = psutil.Process().memory_info().rss
+        self.staging_done_ts: Optional[float] = None
+        # Live pipeline-stage counts, updated by the execution loop:
+        # {stage: count} with stages ready_for_staging/staging/ready_for_io/io.
+        self.stage_counts: dict = {}
+        self.budget_remaining: Optional[int] = None
+        self.total_budget: Optional[int] = None
+
+    def mark_staging_complete(self) -> None:
+        if self.staging_done_ts is None:
+            self.staging_done_ts = time.monotonic()
 
     def report_request_done(self, nbytes: int) -> None:
         self.reqs_done += 1
@@ -94,28 +113,72 @@ class _Reporter:
         if now - self.last_report_ts >= _REPORT_INTERVAL_SEC:
             self.last_report_ts = now
             rss_delta = psutil.Process().memory_info().rss - self.rss_begin
+            counts = " ".join(
+                f"{k}={v}" for k, v in self.stage_counts.items()
+            )
+            budget = (
+                f", budget {self.budget_remaining / 1e9:.1f}/"
+                f"{self.total_budget / 1e9:.1f} GB free"
+                if self.budget_remaining is not None
+                and self.total_budget is not None
+                else ""
+            )
             logger.info(
-                "Rank %d: %s %d/%d reqs, %.2f GB, %.1f MB/s, rss delta %.0f MB",
+                "Rank %d: %s %d/%d reqs [%s done=%d], %.2f GB, %.1f MB/s, "
+                "rss delta %.0f MB%s",
                 self.rank,
                 self.verb,
                 self.reqs_done,
                 self.total_reqs,
+                counts,
+                self.reqs_done,
                 self.bytes_done / 1e9,
                 self.bytes_done / 1e6 / max(now - self.begin_ts, 1e-9),
                 rss_delta / 1e6,
+                budget,
             )
 
     def summarize(self) -> None:
-        elapsed = max(time.monotonic() - self.begin_ts, 1e-9)
-        logger.info(
-            "Rank %d: %s complete: %d reqs, %.2f GB in %.2fs (%.1f MB/s)",
-            self.rank,
-            self.verb,
-            self.reqs_done,
-            self.bytes_done / 1e9,
-            elapsed,
-            self.bytes_done / 1e6 / elapsed,
+        end_ts = time.monotonic()
+        elapsed = max(end_ts - self.begin_ts, 1e-9)
+        staging_elapsed = (
+            max(self.staging_done_ts - self.begin_ts, 0.0)
+            if self.staging_done_ts is not None
+            else None
         )
+        stats = {
+            "reqs": self.reqs_done,
+            "bytes": self.bytes_done,
+            "total_s": elapsed,
+            "staging_s": staging_elapsed,
+            "throughput_mbps": self.bytes_done / 1e6 / elapsed,
+        }
+        LAST_EXECUTION_STATS[self.verb] = stats
+        if staging_elapsed is not None:
+            # The number async_take exists to minimize: training is blocked
+            # only for the staging window, not the full I/O drain.
+            logger.info(
+                "Rank %d: %s complete: %d reqs, %.2f GB in %.2fs "
+                "(%.1f MB/s); staging %.2fs / residual I/O %.2fs",
+                self.rank,
+                self.verb,
+                self.reqs_done,
+                self.bytes_done / 1e9,
+                elapsed,
+                self.bytes_done / 1e6 / elapsed,
+                staging_elapsed,
+                elapsed - staging_elapsed,
+            )
+        else:
+            logger.info(
+                "Rank %d: %s complete: %d reqs, %.2f GB in %.2fs (%.1f MB/s)",
+                self.rank,
+                self.verb,
+                self.reqs_done,
+                self.bytes_done / 1e9,
+                elapsed,
+                self.bytes_done / 1e6 / elapsed,
+            )
 
 
 @dataclass
@@ -216,6 +279,17 @@ async def execute_write_reqs(
             io_tasks.add(asyncio.ensure_future(ready.pop(0).write()))
 
     ready_for_io: List[_WritePipeline] = []
+    reporter.total_budget = memory_budget_bytes
+
+    def update_reporter_state() -> None:
+        reporter.stage_counts = {
+            "ready_for_staging": len(pipelines),
+            "staging": len(staging_tasks),
+            "ready_for_io": len(ready_for_io),
+            "io": len(io_tasks),
+        }
+        reporter.budget_remaining = budget
+
     try:
         dispatch_staging()
         while staging_tasks or pipelines:
@@ -237,10 +311,12 @@ async def execute_write_reqs(
                     reporter.report_request_done(pipeline.buf_size)
             dispatch_io(ready_for_io)
             dispatch_staging()
+            update_reporter_state()
     except BaseException:
         await _cancel_and_drain(staging_tasks | io_tasks)
         executor.shutdown(wait=True)
         raise
+    reporter.mark_staging_complete()
 
     # Staging complete: snapshot content is now frozen. Remaining I/O is
     # handed back so the caller decides whether to drain it in the
@@ -318,6 +394,7 @@ async def execute_read_reqs(
             budget -= head.consuming_cost
             read_tasks.add(asyncio.ensure_future(head.read()))
 
+    reporter.total_budget = memory_budget_bytes
     try:
         dispatch_reads()
         while read_tasks or consume_tasks or pipelines:
@@ -337,6 +414,12 @@ async def execute_read_reqs(
                     budget += pipeline.consuming_cost
                     reporter.report_request_done(pipeline.consuming_cost)
             dispatch_reads()
+            reporter.stage_counts = {
+                "ready_for_read": len(pipelines),
+                "read": len(read_tasks),
+                "consume": len(consume_tasks),
+            }
+            reporter.budget_remaining = budget
     finally:
         executor.shutdown(wait=True)
     reporter.summarize()

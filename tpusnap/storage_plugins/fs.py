@@ -85,7 +85,11 @@ class FSStoragePlugin(StoragePlugin):
         loop = asyncio.get_running_loop()
 
         def work():
-            arr = np.empty(n, dtype=np.uint8)
+            from .. import _native
+
+            # 4096-aligned so the native direct read preads straight into
+            # this buffer (zero-copy) instead of bouncing every chunk.
+            arr = _native.aligned_empty(n)
             got = _read_range(path, offset, n, arr.data)
             return arr, got
 
