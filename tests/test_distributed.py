@@ -215,6 +215,57 @@ def _world_async_take_fault(snap_dir):
         sp.url_to_storage_plugin = orig
 
 
+def _world_async_take_happy(snap_dir):
+    """async_take → training mutates state in place → wait(): the snapshot
+    must hold the PRE-mutation values (defensive-clone invariant under real
+    process parallelism — reference tests/test_async_take.py happy path +
+    io_preparers/tensor.py:281-305). A slow storage plugin guarantees the
+    mutation lands while storage I/O is still in flight."""
+    import asyncio
+
+    import numpy as np
+
+    import tpusnap.storage_plugin as sp
+    from tpusnap import Snapshot, StateDict
+    from tpusnap.comm import get_communicator
+    from tpusnap.storage_plugins.fs import FSStoragePlugin
+
+    comm = get_communicator()
+
+    class SlowFS(FSStoragePlugin):
+        async def write(self, write_io):
+            await asyncio.sleep(1.0)
+            await super().write(write_io)
+
+    orig = sp.url_to_storage_plugin
+    sp.url_to_storage_plugin = lambda url, storage_options=None: SlowFS(
+        root=url.split("://")[-1]
+    )
+    try:
+        state = StateDict(
+            w=np.full((1024,), float(comm.rank), dtype=np.float32),
+            step=0,
+        )
+        pending = Snapshot.async_take(snap_dir, {"s": state})
+        assert not pending.done()
+        # "Training step": mutate the live arrays while I/O drains.
+        state["w"] += 1000.0
+        state["step"] = 99
+        pending.wait()
+    finally:
+        sp.url_to_storage_plugin = orig
+
+    target = {
+        "s": StateDict(w=np.zeros(1024, dtype=np.float32), step=-1)
+    }
+    Snapshot(snap_dir).restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["s"]["w"]),
+        np.full((1024,), float(comm.rank), dtype=np.float32),
+    )
+    assert target["s"]["step"] == 0
+
+
 def _world_elastic_restore(snap_dir, phase):
     import jax.numpy as jnp
     import numpy as np
@@ -294,6 +345,13 @@ def test_async_take_fault_never_commits():
     with tempfile.TemporaryDirectory() as d:
         run_subprocess_world(
             _world_async_take_fault, world_size=2, args=[f"{d}/snap"]
+        )
+
+
+def test_async_take_happy_path_consistent_under_mutation():
+    with tempfile.TemporaryDirectory() as d:
+        run_subprocess_world(
+            _world_async_take_happy, world_size=2, args=[f"{d}/snap"]
         )
 
 

@@ -1,0 +1,106 @@
+"""S3 plugin tests against an in-memory stub client.
+
+The reference gates S3 tests behind a real bucket
+(/root/reference/tests/test_s3_storage_plugin.py:29-49); aiobotocore is
+not available here, so a stub client exercises the plugin's logic: key
+prefixing, body handling for memoryview/bytes, inclusive Range-header
+formatting, and delete.
+"""
+
+import asyncio
+import io
+
+import pytest
+
+from tpusnap.io_types import ReadIO, WriteIO
+from tpusnap.storage_plugins.s3 import S3StoragePlugin
+
+
+class _Body:
+    def __init__(self, data: bytes):
+        self._data = data
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        return False
+
+    async def read(self):
+        return self._data
+
+
+class StubS3Client:
+    def __init__(self):
+        self.objects = {}
+        self.calls = []
+
+    async def put_object(self, Bucket, Key, Body):
+        self.calls.append(("put", Bucket, Key))
+        data = Body.read() if hasattr(Body, "read") else bytes(Body)
+        self.objects[(Bucket, Key)] = bytes(data)
+
+    async def get_object(self, Bucket, Key, Range=None):
+        self.calls.append(("get", Bucket, Key, Range))
+        data = self.objects[(Bucket, Key)]
+        if Range is not None:
+            assert Range.startswith("bytes=")
+            lo, hi = Range[len("bytes=") :].split("-")
+            data = data[int(lo) : int(hi) + 1]  # HTTP Range is inclusive
+        return {"Body": _Body(data)}
+
+    async def delete_object(self, Bucket, Key):
+        self.calls.append(("delete", Bucket, Key))
+        self.objects.pop((Bucket, Key), None)
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+@pytest.fixture()
+def plugin():
+    p = S3StoragePlugin("mybucket/some/prefix")
+    p._client = StubS3Client()
+    return p
+
+
+def test_construction_parses_root():
+    p = S3StoragePlugin("bucket/deep/prefix")
+    assert p.bucket == "bucket" and p.root == "deep/prefix"
+    with pytest.raises(ValueError):
+        S3StoragePlugin("bucketonly")
+
+
+def test_write_read_round_trip(plugin):
+    payload = bytes(range(256)) * 10
+    _run(plugin.write(WriteIO(path="rank0/w", buf=memoryview(payload))))
+    assert plugin._client.objects[("mybucket", "some/prefix/rank0/w")] == payload
+    read_io = ReadIO(path="rank0/w")
+    _run(plugin.read(read_io))
+    assert read_io.buf.getvalue() == payload
+
+
+def test_bytes_body(plugin):
+    _run(plugin.write(WriteIO(path="b", buf=b"hello")))
+    assert plugin._client.objects[("mybucket", "some/prefix/b")] == b"hello"
+
+
+def test_ranged_read_inclusive_header(plugin):
+    payload = bytes(range(200))
+    _run(plugin.write(WriteIO(path="r", buf=memoryview(payload))))
+    read_io = ReadIO(path="r", byte_range=(10, 60))
+    _run(plugin.read(read_io))
+    assert read_io.buf.getvalue() == payload[10:60]
+    get_call = [c for c in plugin._client.calls if c[0] == "get"][0]
+    assert get_call[3] == "bytes=10-59"  # end-exclusive -> inclusive
+
+
+def test_delete(plugin):
+    _run(plugin.write(WriteIO(path="d", buf=b"x")))
+    _run(plugin.delete("d"))
+    assert ("mybucket", "some/prefix/d") not in plugin._client.objects

@@ -201,3 +201,39 @@ def test_read_budget_gating(tmp_path):
         assert sink == blobs
     finally:
         loop.close()
+
+
+def test_reporter_stats_and_log_split(tmp_path, caplog, monkeypatch):
+    """Reporter parity (reference scheduler.py:96-175): the final summary
+    logs the staging-time vs total-time split, periodic reports carry
+    per-stage pipeline counts + remaining budget, and the split is
+    published via LAST_EXECUTION_STATS for benchmarks."""
+    import logging
+
+    from tpusnap import scheduler as sched
+
+    monkeypatch.setattr(sched, "_REPORT_INTERVAL_SEC", 0.0)
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    write_reqs = [
+        WriteReq(path=f"w{i}", buffer_stager=TrackingStager(os.urandom(256), 256))
+        for i in range(5)
+    ]
+    loop = asyncio.new_event_loop()
+    try:
+        with caplog.at_level(logging.INFO, logger="tpusnap.scheduler"):
+            pending = sync_execute_write_reqs(
+                write_reqs, plugin, 10_000, rank=0, event_loop=loop
+            )
+            pending.sync_complete(loop)
+    finally:
+        loop.close()
+    stats = sched.LAST_EXECUTION_STATS["write"]
+    assert stats["reqs"] == 5
+    assert stats["bytes"] == 5 * 256
+    assert stats["staging_s"] is not None
+    assert 0 <= stats["staging_s"] <= stats["total_s"]
+    text = caplog.text
+    assert "staging" in text and "residual I/O" in text
+    # Per-stage counts + budget appear in at least one periodic report.
+    assert "ready_for_staging=" in text and "io=" in text
+    assert "budget" in text

@@ -1,0 +1,82 @@
+"""Memory-budgeted (tiled) reads — the reference's signature
+memory-bounded load (io_preparers/tensor.py:126-179, validated by
+benchmarks/load_tensor/main.py:24-61): ``read_object`` of an array far
+larger than the budget must stream byte-ranged tiles, keeping peak RSS
+near the budget instead of materializing a second full copy.
+"""
+
+import numpy as np
+import pytest
+
+from tpusnap import Snapshot, StateDict
+from tpusnap.knobs import override_slab_size_threshold_bytes
+from tpusnap.rss_profiler import measure_rss_deltas
+
+MB = 1024 * 1024
+
+
+@pytest.fixture()
+def big_snapshot(tmp_path):
+    rng = np.random.default_rng(3)
+    arr = rng.integers(0, 2**16, (192, 256 * 1024), dtype=np.uint16)  # 96 MiB
+    Snapshot.take(str(tmp_path / "snap"), {"s": StateDict(w=arr)})
+    return str(tmp_path / "snap"), arr
+
+
+def test_tiled_read_correct_and_budgeted(big_snapshot):
+    path, arr = big_snapshot
+    budget = 8 * MB
+    rss_deltas = []
+    with measure_rss_deltas(rss_deltas):
+        out = Snapshot(path).read_object(
+            "0/s/w", memory_budget_bytes=budget
+        )
+    assert np.array_equal(out, arr)
+    # Prove the tiled path ran: one 96 MiB tensor under an 8 MiB budget
+    # must split into many byte-ranged tile reads, not one dense read.
+    from tpusnap.scheduler import LAST_EXECUTION_STATS
+
+    assert LAST_EXECUTION_STATS["read"]["reqs"] >= 10
+    # Peak transient RSS beyond the (unavoidable) full-size destination
+    # must stay near the budget: destination + concurrent in-flight tiles.
+    # The scheduler keeps <= budget of tiles in flight plus one always-
+    # allowed over-budget item; 4x headroom still catches the failure mode
+    # (a second full 96 MiB copy).
+    peak = max(rss_deltas, default=0)
+    assert peak < arr.nbytes + 4 * budget, (
+        f"peak RSS delta {peak / MB:.0f} MiB exceeds destination+4x budget"
+    )
+
+
+def test_tiled_read_in_place_target(big_snapshot):
+    path, arr = big_snapshot
+    target = np.zeros_like(arr)
+    out = Snapshot(path).read_object(
+        "0/s/w", obj_out=target, memory_budget_bytes=8 * MB
+    )
+    assert out is target
+    assert np.array_equal(target, arr)
+
+
+def test_tiled_read_of_slab_resident_entry(tmp_path):
+    """Byte-ranged source: a batched (slab-resident) tensor read with a
+    budget smaller than the tensor must tile WITHIN the slab's byte range."""
+    rng = np.random.default_rng(4)
+    arrs = {
+        f"w{i}": rng.integers(0, 2**16, (64, 64 * 1024), dtype=np.uint16)
+        for i in range(3)
+    }  # 8 MiB each — small enough to batch under a shrunken threshold
+    with override_slab_size_threshold_bytes(64 * MB):
+        Snapshot.take(str(tmp_path / "snap"), {"s": StateDict(**arrs)})
+    snap = Snapshot(str(tmp_path / "snap"))
+    manifest = snap.get_manifest()
+    entry = manifest["0/s/w1"]
+    assert entry.byte_range is not None, "arrays were not slab-batched"
+    out = snap.read_object("0/s/w1", memory_budget_bytes=1 * MB)
+    assert np.array_equal(out, arrs["w1"])
+
+
+def test_unbudgeted_read_object_unchanged(big_snapshot):
+    path, arr = big_snapshot
+    out = Snapshot(path).read_object("0/s/w")
+    assert np.array_equal(out, arr)

@@ -122,12 +122,6 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_size_t,
     ]
     lib.ts_write_file.restype = ctypes.c_int
-    lib.ts_write_file_direct.argtypes = [
-        ctypes.c_char_p,
-        ctypes.c_void_p,
-        ctypes.c_size_t,
-    ]
-    lib.ts_write_file_direct.restype = ctypes.c_int
     lib.ts_write_file_direct2.argtypes = [
         ctypes.c_char_p,
         ctypes.c_void_p,

@@ -7,12 +7,15 @@
 //
 //   ts_write_file    — whole-buffer file write (single open/write loop, no
 //                      Python-level chunking, GIL released by the caller)
-//   ts_write_file_direct — O_DIRECT double-buffered write: bypasses the
-//                      page cache (whose dirty-page writeback throttling
-//                      caps buffered writes well below device speed on
-//                      large checkpoint streams); memcpy into an aligned
-//                      bounce buffer overlaps with the in-flight pwrite
+//   ts_write_file_auto — engine-picking whole-file write: O_DIRECT
+//                      zero-copy for aligned sources, RWF_DONTCACHE
+//                      uncached buffered I/O for unaligned ones, bounce
+//                      pipeline fallback (ts_write_file_direct2); plain
+//                      buffered writes hit the dirty-page writeback
+//                      throttle well below device speed on large streams
 //   ts_read_range    — positional ranged read into a caller buffer
+//                      (ts_read_range_direct2: O_DIRECT, preads straight
+//                      into aligned destinations)
 //   ts_memcpy_par    — multi-threaded memcpy for staging large host buffers
 //   ts_crc32c        — CRC32C (Castagnoli, software slice-by-8) for
 //                      optional integrity checksums
@@ -28,12 +31,15 @@
 #include <deque>
 #include <fcntl.h>
 #include <sys/stat.h>
-#include <sys/statfs.h>
 #include <sys/types.h>
-#include <sys/uio.h>
 #include <thread>
 #include <unistd.h>
 #include <vector>
+
+#ifdef __linux__
+#include <sys/statfs.h>
+#include <sys/uio.h>
+#endif
 
 // Uncached buffered I/O (Linux 6.14+): write through the page cache —
 // so no alignment requirements and a single CPU copy — but kick off
@@ -61,9 +67,14 @@
 // "device" is a kernel memcpy: the direct path's bounce buffer would just
 // add a second CPU copy. A single buffered write is the fastest option.
 static bool is_ram_backed(int fd) {
+#ifdef __linux__
   struct statfs sfs;
   if (::fstatfs(fd, &sfs) != 0) return false;
   return sfs.f_type == TMPFS_MAGIC || sfs.f_type == RAMFS_MAGIC;
+#else
+  (void)fd;
+  return false;
+#endif
 }
 
 extern "C" {
@@ -173,7 +184,12 @@ int ts_write_file_direct2(const char* path, const void* buf, size_t n,
     for (auto& t : workers) t.join();
   } else {
     // Bounce pipeline: nthreads in-flight chunk writes, nthreads+1
-    // bounce buffers so the caller's memcpy overlaps all of them.
+    // bounce buffers so the caller's memcpy overlaps all of them. The
+    // bounce chunk is capped at 8 MiB regardless of the zero-copy chunk
+    // knob: this memory is invisible to the scheduler's staging budget,
+    // and at the scheduler's 16-file I/O concurrency larger chunks would
+    // pin (16 x (qd+1) x chunk) of untracked RSS.
+    if (chunk > (8u << 20)) chunk = 8u << 20;
     const int nbufs = nthreads + 1;
     std::vector<void*> bounce(nbufs, nullptr);
     bool alloc_ok = true;
@@ -274,17 +290,16 @@ int ts_write_file_direct2(const char* path, const void* buf, size_t n,
   return 0;
 }
 
-// Back-compat entry point: QD 2 with 32 MiB chunks (measured best on
-// virtio/NVMe: deeper per-file queues with larger chunks out-run the old
-// single-in-flight 8 MiB double-buffer by ~30% aggregate).
-int ts_write_file_direct(const char* path, const void* buf, size_t n) {
-  return ts_write_file_direct2(path, buf, n, 2, 32u << 20);
-}
-
 // Whole-file write via uncached buffered I/O (RWF_DONTCACHE). Returns 0
 // or -errno; -EOPNOTSUPP/-EINVAL mean the kernel/filesystem lacks
 // support and the caller should fall back to the O_DIRECT path.
 int ts_write_file_dontcache(const char* path, const void* buf, size_t n) {
+#ifndef __linux__
+  (void)path;
+  (void)buf;
+  (void)n;
+  return -EOPNOTSUPP;
+#else
   static const size_t kChunk = 8u << 20;
   int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
   if (fd < 0) return -errno;
@@ -309,6 +324,7 @@ int ts_write_file_dontcache(const char* path, const void* buf, size_t n) {
   }
   if (::close(fd) < 0) return -errno;
   return 0;
+#endif
 }
 
 // Preferred whole-file write: picks the cheapest correct engine.

@@ -70,21 +70,38 @@ class GCSStoragePlugin(StoragePlugin):
     def __init__(
         self, root: str, storage_options: Optional[Dict[str, Any]] = None
     ) -> None:
-        try:
-            import google.auth
-            from google.auth.transport.requests import AuthorizedSession
-        except ImportError as e:
-            raise RuntimeError(
-                "GCS support requires google-auth (pip install google-auth)"
-            ) from e
         components = root.split("/", 1)
         if len(components) != 2 or not components[0]:
             raise ValueError(f"Invalid gcs root: {root!r} (expected gs://bucket/prefix)")
         self.bucket, self.root = components[0], components[1]
         storage_options = storage_options or {}
-        scopes = ["https://www.googleapis.com/auth/devstorage.read_write"]
-        credentials, _ = google.auth.default(scopes=scopes)
-        self._session = AuthorizedSession(credentials)
+        # Emulator/fake-server support (same convention as the official
+        # client libraries): STORAGE_EMULATOR_HOST or an explicit
+        # api_endpoint skip auth entirely and use a plain session.
+        endpoint = storage_options.get("api_endpoint") or os.environ.get(
+            "STORAGE_EMULATOR_HOST"
+        )
+        if endpoint:
+            import requests
+
+            if "://" not in endpoint:
+                # fake-gcs-server convention: scheme-less host:port. The
+                # official client libraries prepend http:// too.
+                endpoint = f"http://{endpoint}"
+            self._endpoint = endpoint.rstrip("/")
+            self._session = requests.Session()
+        else:
+            try:
+                import google.auth
+                from google.auth.transport.requests import AuthorizedSession
+            except ImportError as e:
+                raise RuntimeError(
+                    "GCS support requires google-auth (pip install google-auth)"
+                ) from e
+            scopes = ["https://www.googleapis.com/auth/devstorage.read_write"]
+            credentials, _ = google.auth.default(scopes=scopes)
+            self._endpoint = "https://storage.googleapis.com"
+            self._session = AuthorizedSession(credentials)
         self._executor = ThreadPoolExecutor(
             max_workers=int(storage_options.get("max_workers", 16)),
             thread_name_prefix="tpusnap-gcs",
@@ -102,7 +119,7 @@ class GCSStoragePlugin(StoragePlugin):
         from urllib.parse import quote
 
         url = (
-            f"https://storage.googleapis.com/upload/storage/v1/b/{self.bucket}/o"
+            f"{self._endpoint}/upload/storage/v1/b/{self.bucket}/o"
             f"?uploadType=resumable&name={quote(name, safe='')}"
         )
         resp = self._session.post(url, json={})
@@ -153,7 +170,7 @@ class GCSStoragePlugin(StoragePlugin):
         from urllib.parse import quote
 
         url = (
-            f"https://storage.googleapis.com/upload/storage/v1/b/{self.bucket}/o"
+            f"{self._endpoint}/upload/storage/v1/b/{self.bucket}/o"
             f"?uploadType=media&name={quote(name, safe='')}"
         )
         resp = self._session.post(url, data=b"")
@@ -163,7 +180,7 @@ class GCSStoragePlugin(StoragePlugin):
         from urllib.parse import quote
 
         url = (
-            f"https://storage.googleapis.com/storage/v1/b/{self.bucket}"
+            f"{self._endpoint}/storage/v1/b/{self.bucket}"
             f"/o/{quote(name, safe='')}?alt=media"
         )
         headers = {"Range": f"bytes={start}-{end - 1}"}
@@ -175,7 +192,7 @@ class GCSStoragePlugin(StoragePlugin):
         from urllib.parse import quote
 
         url = (
-            f"https://storage.googleapis.com/storage/v1/b/{self.bucket}"
+            f"{self._endpoint}/storage/v1/b/{self.bucket}"
             f"/o/{quote(name, safe='')}"
         )
         resp = self._session.get(url)
@@ -186,7 +203,7 @@ class GCSStoragePlugin(StoragePlugin):
         from urllib.parse import quote
 
         url = (
-            f"https://storage.googleapis.com/storage/v1/b/{self.bucket}"
+            f"{self._endpoint}/storage/v1/b/{self.bucket}"
             f"/o/{quote(name, safe='')}"
         )
         resp = self._session.delete(url)

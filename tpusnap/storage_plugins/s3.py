@@ -17,26 +17,28 @@ class S3StoragePlugin(StoragePlugin):
     def __init__(
         self, root: str, storage_options: Optional[Dict[str, Any]] = None
     ) -> None:
-        try:
-            from aiobotocore.session import get_session
-        except ImportError as e:
-            raise RuntimeError(
-                "S3 support requires aiobotocore (pip install aiobotocore)"
-            ) from e
         components = root.split("/", 1)
         if len(components) != 2 or not components[0]:
             raise ValueError(
                 f"Invalid s3 root: {root!r} (expected s3://bucket/prefix)"
             )
         self.bucket, self.root = components[0], components[1]
-        self.session = get_session()
         self._client = None
         self._client_ctx = None
         self._storage_options = storage_options or {}
+        # The aiobotocore import is deferred to first use so construction
+        # works without the package — tests inject a stub via _client, and
+        # environments without S3 can still import/route every plugin.
 
     async def _get_client(self):
         if self._client is None:
-            self._client_ctx = self.session.create_client(
+            try:
+                from aiobotocore.session import get_session
+            except ImportError as e:
+                raise RuntimeError(
+                    "S3 support requires aiobotocore (pip install aiobotocore)"
+                ) from e
+            self._client_ctx = get_session().create_client(
                 "s3", **self._storage_options.get("client_kwargs", {})
             )
             self._client = await self._client_ctx.__aenter__()
