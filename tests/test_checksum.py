@@ -1,0 +1,148 @@
+"""Per-blob CRC32C integrity checksums (beyond the reference, which has
+no end-to-end integrity checking): recorded at stage time into the
+manifest, verified on read; a flipped bit in storage must fail the
+restore naming the corrupted blob.
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusnap import Snapshot, StateDict
+from tpusnap._native import ChecksumError
+from tpusnap.knobs import (
+    override_checksum_disabled,
+    override_max_chunk_size_bytes,
+    override_slab_size_threshold_bytes,
+)
+
+
+def _corrupt_one_byte(snap_dir: str, name_fragment: str, offset: int = 100) -> str:
+    """Flip one byte in the first blob file matching the fragment."""
+    for f in sorted(glob.glob(f"{snap_dir}/**/*", recursive=True)):
+        if os.path.isfile(f) and name_fragment in f and not f.endswith(".snapshot_metadata"):
+            with open(f, "r+b") as fh:
+                fh.seek(offset)
+                b = fh.read(1)
+                fh.seek(offset)
+                fh.write(bytes([b[0] ^ 0xFF]))
+            return f
+    raise AssertionError(f"no blob matching {name_fragment!r} in {snap_dir}")
+
+
+def test_checksums_recorded_in_manifest(tmp_path):
+    arr = np.arange(4096, dtype=np.float32)
+    # A set is not flattenable, so it persists as a pickled ObjectEntry.
+    Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=arr, meta={1, 2, 3})})
+    manifest = Snapshot(str(tmp_path / "s")).get_manifest()
+    tensor_entry = manifest["0/m/w"]
+    assert tensor_entry.checksum is not None
+    algo, _, value = tensor_entry.checksum.partition(":")
+    assert algo in ("crc32c", "zlib-crc32") and len(value) == 8
+    obj_entry = manifest["0/m/meta"]
+    assert obj_entry.checksum is not None
+
+
+def test_corrupt_tensor_fails_restore_naming_path(tmp_path):
+    arr = np.random.default_rng(0).standard_normal(100_000).astype(np.float32)
+    Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=arr)})
+    _corrupt_one_byte(str(tmp_path / "s"), "w")
+    target = {"m": StateDict(w=np.zeros_like(arr))}
+    with pytest.raises(ChecksumError, match="m/w"):
+        Snapshot(str(tmp_path / "s")).restore(target)
+
+
+def test_corrupt_object_fails_restore(tmp_path):
+    # A set pickles as one ObjectEntry blob (dicts flatten into containers
+    # whose string leaves are inlined primitives with no blob to corrupt).
+    Snapshot.take(
+        str(tmp_path / "s"), {"m": StateDict(cfg={"x" * 4000, "y"})}
+    )
+    _corrupt_one_byte(str(tmp_path / "s"), "cfg")
+    target = {"m": StateDict(cfg=None)}
+    with pytest.raises(ChecksumError, match="cfg"):
+        Snapshot(str(tmp_path / "s")).restore(target)
+
+
+def test_corrupt_slab_member_fails_read_object(tmp_path):
+    """Batched (slab-resident) members carry member-grain checksums."""
+    arrs = {f"w{i}": np.full(2048, float(i), dtype=np.float32) for i in range(4)}
+    with override_slab_size_threshold_bytes(1 << 20):
+        Snapshot.take(str(tmp_path / "s"), {"m": StateDict(**arrs)})
+    snap = Snapshot(str(tmp_path / "s"))
+    entry = snap.get_manifest()["0/m/w2"]
+    assert entry.byte_range is not None, "state was not slab-batched"
+    assert entry.checksum is not None
+    # Corrupt one byte INSIDE w2's byte range of the slab.
+    for f in glob.glob(f"{tmp_path}/s/batched/*"):
+        with open(f, "r+b") as fh:
+            fh.seek(entry.byte_range[0] + 8)
+            b = fh.read(1)
+            fh.seek(entry.byte_range[0] + 8)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        break
+    else:
+        raise AssertionError("no slab file found")
+    with pytest.raises(ChecksumError, match="w2"):
+        snap.read_object("0/m/w2")
+    # Untouched member still reads fine.
+    out = snap.read_object("0/m/w1")
+    assert np.array_equal(out, arrs["w1"])
+
+
+def test_corrupt_chunk_fails_restore(tmp_path):
+    arr = np.random.default_rng(1).standard_normal((64, 1024)).astype(np.float32)
+    with override_max_chunk_size_bytes(64 * 1024):
+        Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=arr)})
+    manifest = Snapshot(str(tmp_path / "s")).get_manifest()
+    entry = manifest["0/m/w"]
+    assert entry.type == "ChunkedTensor" and len(entry.chunks) > 1
+    assert all(c.tensor.checksum for c in entry.chunks)
+    _corrupt_one_byte(str(tmp_path / "s"), entry.chunks[1].tensor.location.rsplit("/", 1)[-1])
+    target = {"m": StateDict(w=np.zeros_like(arr))}
+    with pytest.raises(ChecksumError):
+        Snapshot(str(tmp_path / "s")).restore(target)
+
+
+def test_corrupt_shard_fails_sharded_restore(tmp_path):
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]).reshape(4), ("dp",))
+    sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("dp"))
+    arr = jax.device_put(jnp.arange(32768, dtype=jnp.float32), sharding)
+    Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=arr)})
+    manifest = Snapshot(str(tmp_path / "s")).get_manifest()
+    entry = manifest["0/m/w"]
+    assert all(s.tensor.checksum for s in entry.shards)
+    _corrupt_one_byte(str(tmp_path / "s"), "w.8192")
+    target = {"m": StateDict(w=jax.device_put(jnp.zeros(32768, jnp.float32), sharding))}
+    with pytest.raises(ChecksumError, match="8192"):
+        Snapshot(str(tmp_path / "s")).restore(target)
+
+
+def test_checksum_knob_disables_both_sides(tmp_path):
+    arr = np.arange(50_000, dtype=np.float32)
+    with override_checksum_disabled(True):
+        Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=arr)})
+        manifest = Snapshot(str(tmp_path / "s")).get_manifest()
+        assert manifest["0/m/w"].checksum is None
+    # Snapshot taken WITH checksums, corrupted, read with verification off:
+    Snapshot.take(str(tmp_path / "s2"), {"m": StateDict(w=arr)})
+    _corrupt_one_byte(str(tmp_path / "s2"), "w")
+    with override_checksum_disabled(True):
+        target = {"m": StateDict(w=np.zeros_like(arr))}
+        Snapshot(str(tmp_path / "s2")).restore(target)  # no raise
+        assert not np.array_equal(target["m"]["w"], arr)
+
+
+def test_budget_tiled_read_skips_verification(tmp_path):
+    """Sub-blob tiles cannot be checked against a whole-blob checksum —
+    but they must still read correctly (no spurious failures)."""
+    arr = np.random.default_rng(2).integers(0, 2**16, (256, 4096), dtype=np.uint16)
+    Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=arr)})
+    out = Snapshot(str(tmp_path / "s")).read_object(
+        "0/m/w", memory_budget_bytes=64 * 1024
+    )
+    assert np.array_equal(out, arr)

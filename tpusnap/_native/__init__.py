@@ -342,3 +342,39 @@ def crc32c(buf, seed: int = 0) -> int:
 
 def checksum_algorithm() -> str:
     return "crc32c" if available() else "zlib-crc32"
+
+
+def checksum_string(buf) -> str:
+    """``"<algo>:<8-hex>"`` checksum of a buffer, for manifest entries."""
+    return f"{checksum_algorithm()}:{crc32c(buf) & 0xFFFFFFFF:08x}"
+
+
+class ChecksumError(IOError):
+    """A restored blob's bytes do not match the checksum recorded at save
+    time — storage or transport corrupted the data."""
+
+
+def verify_checksum(buf, recorded: str, location: str) -> None:
+    """Verify a read buffer against the manifest-recorded checksum.
+
+    An algorithm mismatch (snapshot written by a build whose native
+    helper/fallback used a different polynomial) is skipped with a
+    warning — the bytes may be fine; only a same-algorithm mismatch is
+    proof of corruption."""
+    algo, _, value = recorded.partition(":")
+    if algo != checksum_algorithm():
+        logger.warning(
+            "skipping checksum verification for %s: snapshot used %s, "
+            "this build computes %s",
+            location,
+            algo,
+            checksum_algorithm(),
+        )
+        return
+    actual = crc32c(buf) & 0xFFFFFFFF
+    if actual != int(value, 16):
+        raise ChecksumError(
+            f"checksum mismatch for {location!r}: stored {recorded}, "
+            f"read bytes hash to {algo}:{actual:08x} — the blob was "
+            "corrupted in storage or transit"
+        )

@@ -662,6 +662,22 @@ uint32_t ts_crc32c(const void* buf, size_t n, uint32_t seed) {
   (void)kCrcInit;
   uint32_t crc = ~seed;
   const uint8_t* p = static_cast<const uint8_t*>(buf);
+#ifdef __SSE4_2__
+  // Hardware CRC32C (the checksum exists to run at stage time inside the
+  // take's hot path — software slice-by-8 tops out ~1-2 GB/s/core, the
+  // crc32 instruction ~15-20 GB/s).
+  uint64_t crc64 = crc;
+  while (n >= 8) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    crc64 = __builtin_ia32_crc32di(crc64, v);
+    p += 8;
+    n -= 8;
+  }
+  crc = static_cast<uint32_t>(crc64);
+  while (n--) crc = __builtin_ia32_crc32qi(crc, *p++);
+  return ~crc;
+#else
   while (n >= 8) {
     crc ^= static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
            (static_cast<uint32_t>(p[2]) << 16) |
@@ -675,6 +691,7 @@ uint32_t ts_crc32c(const void* buf, size_t n, uint32_t seed) {
   }
   while (n--) crc = (crc >> 8) ^ kCrcTable[0][(crc ^ *p++) & 0xff];
   return ~crc;
+#endif
 }
 
 }  // extern "C"

@@ -133,12 +133,24 @@ class DeviceBatchedBufferStager(BufferStager):
     def _stage_blocking(self) -> BufferType:
         import numpy as np
 
+        from .knobs import is_checksum_disabled
+
         packed = _pack_on_device(tuple(s.arr for _, _, s in self.members))
         host = np.asarray(packed)  # the single DtoH DMA
         if host.nbytes != self.total:
             raise RuntimeError(
                 f"device-packed slab is {host.nbytes} bytes, expected {self.total}"
             )
+        if not is_checksum_disabled():
+            # The members' own stagers are bypassed by the device-side
+            # pack, so record their checksums from the slab slices here.
+            from . import _native
+
+            for offset, nbytes, stager in self.members:
+                if stager.entry is not None:
+                    stager.entry.checksum = _native.checksum_string(
+                        host[offset : offset + nbytes]
+                    )
         return host
 
     def get_staging_cost_bytes(self) -> int:

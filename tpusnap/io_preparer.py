@@ -83,19 +83,25 @@ def prepare_read(
     entry: Entry,
     obj_out: Any = None,
     buffer_size_limit_bytes: Optional[int] = None,
+    logical_path: str = "",
 ) -> Tuple[List[ReadReq], Future]:
+    """``logical_path`` labels integrity failures with the user-facing
+    manifest path — slab-batched blobs' storage locations are opaque
+    uuids, useless in a corruption report."""
     if isinstance(entry, PrimitiveEntry):
         return [], Future(obj=entry.get_value())
     if isinstance(entry, ShardedEntry):
         return ShardedArrayIOPreparer.prepare_read(
-            entry, obj_out, buffer_size_limit_bytes
+            entry, obj_out, buffer_size_limit_bytes, logical_path=logical_path
         )
     if isinstance(entry, ChunkedTensorEntry):
         return ChunkedArrayIOPreparer.prepare_read(
-            entry, obj_out, buffer_size_limit_bytes
+            entry, obj_out, buffer_size_limit_bytes, logical_path=logical_path
         )
     if isinstance(entry, TensorEntry):
-        return ArrayIOPreparer.prepare_read(entry, obj_out, buffer_size_limit_bytes)
+        return ArrayIOPreparer.prepare_read(
+            entry, obj_out, buffer_size_limit_bytes, logical_path=logical_path
+        )
     if isinstance(entry, ObjectEntry):
-        return ObjectIOPreparer.prepare_read(entry)
+        return ObjectIOPreparer.prepare_read(entry, logical_path=logical_path)
     raise TypeError(f"Cannot prepare read for entry type {type(entry).__name__}")

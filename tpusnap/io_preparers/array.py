@@ -77,9 +77,18 @@ def enqueue_dtoh(arr: ArrayLike) -> None:
 
 
 class ArrayBufferStager(BufferStager):
-    def __init__(self, arr: ArrayLike, is_async_snapshot: bool = False) -> None:
+    def __init__(
+        self,
+        arr: ArrayLike,
+        is_async_snapshot: bool = False,
+        entry: Optional[TensorEntry] = None,
+    ) -> None:
         self.arr = arr
         self.is_async_snapshot = is_async_snapshot
+        # Manifest entry to annotate with the stage-time checksum. The
+        # manifest is gathered after staging completes, so the value lands
+        # in the committed metadata.
+        self.entry = entry
         enqueue_dtoh(arr)
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
@@ -89,8 +98,14 @@ class ArrayBufferStager(BufferStager):
         return self._stage_blocking()
 
     def _stage_blocking(self) -> BufferType:
+        from ..knobs import is_checksum_disabled
+
         host = np.asarray(self.arr)  # DtoH (no-op if DMA already done)
         mv = array_as_memoryview(host)
+        if self.entry is not None and not is_checksum_disabled():
+            from .. import _native
+
+            self.entry.checksum = _native.checksum_string(mv)
         if self.is_async_snapshot and _may_alias_live_memory(self.arr, host):
             # Defensive clone: training resumes before I/O completes, and a
             # donated buffer could be overwritten under us. The native
@@ -120,10 +135,17 @@ class ArrayBufferConsumer(BufferConsumer):
     device_put with the target's sharding; numpy targets are filled in
     place (the reference's in-place load, tensor.py:188-196)."""
 
-    def __init__(self, entry: TensorEntry, obj_out: Optional[ArrayLike], fut: Future):
+    def __init__(
+        self,
+        entry: TensorEntry,
+        obj_out: Optional[ArrayLike],
+        fut: Future,
+        verify_location: str = "",
+    ):
         self.entry = entry
         self.obj_out = obj_out
         self.fut = fut
+        self.verify_location = verify_location or entry.location
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -135,11 +157,27 @@ class ArrayBufferConsumer(BufferConsumer):
             self._consume_blocking(buf)
 
     def _consume_blocking(self, buf: BufferType) -> None:
+        _maybe_verify(buf, self.entry.checksum, self.verify_location)
         value = materialize_array(self.entry, buf, self.obj_out)
         self.fut.obj = value
 
     def get_consuming_cost_bytes(self) -> int:
         return tensor_nbytes(self.entry.dtype, self.entry.shape)
+
+
+def _maybe_verify(buf: BufferType, checksum: Optional[str], location: str) -> None:
+    """Verify a full-blob read against the manifest checksum (knob-gated).
+    Callers reading a sub-range of an entry's bytes (budget tiles) must
+    pass checksum=None — the recorded value covers the whole entry."""
+    if checksum is None:
+        return
+    from ..knobs import is_checksum_disabled
+
+    if is_checksum_disabled():
+        return
+    from .. import _native
+
+    _native.verify_checksum(memoryview(buf).cast("B"), checksum, location)
 
 
 def materialize_array(
@@ -185,7 +223,7 @@ class ArrayIOPreparer:
         write_reqs = [
             WriteReq(
                 path=storage_path,
-                buffer_stager=ArrayBufferStager(arr, is_async_snapshot),
+                buffer_stager=ArrayBufferStager(arr, is_async_snapshot, entry=entry),
             )
         ]
         return entry, write_reqs
@@ -195,6 +233,7 @@ class ArrayIOPreparer:
         entry: TensorEntry,
         obj_out: Optional[ArrayLike] = None,
         buffer_size_limit_bytes: Optional[int] = None,
+        logical_path: str = "",
     ) -> Tuple[List[ReadReq], Future]:
         fut: Future = Future()
         nbytes = tensor_nbytes(entry.dtype, entry.shape)
@@ -212,7 +251,9 @@ class ArrayIOPreparer:
             ReadReq(
                 path=entry.location,
                 byte_range=byte_range,
-                buffer_consumer=ArrayBufferConsumer(entry, obj_out, fut),
+                buffer_consumer=ArrayBufferConsumer(
+                    entry, obj_out, fut, verify_location=logical_path
+                ),
             )
         ]
         return read_reqs, fut
@@ -271,7 +312,19 @@ class ArrayIOPreparer:
 
 
 class _TileConsumer(BufferConsumer):
-    def __init__(self, entry, host_out, r0, r1, remaining, fut, obj_out, in_place):
+    def __init__(
+        self,
+        entry,
+        host_out,
+        r0,
+        r1,
+        remaining,
+        fut,
+        obj_out,
+        in_place,
+        blob_checksum=None,
+        blob_location="",
+    ):
         self.entry = entry
         self.host_out = host_out
         self.r0, self.r1 = r0, r1
@@ -279,6 +332,11 @@ class _TileConsumer(BufferConsumer):
         self.fut = fut
         self.obj_out = obj_out
         self.in_place = in_place
+        # Set only when this consumer's read covers a complete stored blob
+        # (chunked reads); budget tiles read sub-ranges of one blob, which
+        # the whole-blob checksum cannot verify.
+        self.blob_checksum = blob_checksum
+        self.blob_location = blob_location
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -301,6 +359,7 @@ class _TileConsumer(BufferConsumer):
                 self.fut.obj = self.host_out
 
     def _consume_blocking(self, buf: BufferType) -> None:
+        _maybe_verify(buf, self.blob_checksum, self.blob_location)
         tile_shape = [self.r1 - self.r0] + list(self.entry.shape[1:])
         src = array_from_memoryview(memoryview(buf), self.entry.dtype, tile_shape)
         np.copyto(self.host_out[self.r0 : self.r1], src)

@@ -76,7 +76,9 @@ class ChunkedArrayIOPreparer:
             write_reqs.append(
                 WriteReq(
                     path=location,
-                    buffer_stager=ArrayBufferStager(sub, is_async_snapshot),
+                    buffer_stager=ArrayBufferStager(
+                        sub, is_async_snapshot, entry=tensor_entry
+                    ),
                 )
             )
         entry = ChunkedTensorEntry(
@@ -89,6 +91,7 @@ class ChunkedArrayIOPreparer:
         entry: ChunkedTensorEntry,
         obj_out=None,
         buffer_size_limit_bytes: Optional[int] = None,
+        logical_path: str = "",
     ) -> Tuple[List[ReadReq], Future]:
         """Chunks land in one preallocated host array via narrow views
         (reference chunked_tensor.py:65-126)."""
@@ -131,6 +134,13 @@ class ChunkedArrayIOPreparer:
                         fut,
                         obj_out,
                         in_place,
+                        # Each chunk read covers one complete stored blob,
+                        # so the chunk's whole-blob checksum is verifiable.
+                        blob_checksum=tensor_entry.checksum,
+                        blob_location=(
+                            f"{logical_path or tensor_entry.location} "
+                            f"(chunk @ row {r0})"
+                        ),
                     ),
                 )
             )

@@ -39,13 +39,24 @@ class ObjectBufferStager(BufferStager):
 
 
 class ObjectBufferConsumer(BufferConsumer):
-    def __init__(self, fut: Future, nbytes: int) -> None:
+    def __init__(
+        self,
+        fut: Future,
+        nbytes: int,
+        checksum: Optional[str] = None,
+        location: str = "",
+    ) -> None:
         self.fut = fut
         self.nbytes = nbytes
+        self.checksum = checksum
+        self.location = location
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
+        from .array import _maybe_verify
+
+        _maybe_verify(buf, self.checksum, self.location)
         if executor is not None:
             loop = asyncio.get_running_loop()
             self.fut.obj = await loop.run_in_executor(
@@ -64,17 +75,32 @@ class ObjectIOPreparer:
         storage_path: str, obj: Any, replicated: bool = False
     ) -> Tuple[ObjectEntry, List[WriteReq]]:
         buf = pickle_as_bytes(obj)
+        from ..knobs import is_checksum_disabled
+
+        checksum = None
+        if not is_checksum_disabled():
+            from .. import _native
+
+            checksum = _native.checksum_string(buf)
         entry = ObjectEntry(
             location=storage_path,
             serializer=Serializer.PICKLE.value,
             obj_type=type(obj).__name__,
             replicated=replicated,
             nbytes=len(buf),
+            checksum=checksum,
         )
         return entry, [WriteReq(path=storage_path, buffer_stager=ObjectBufferStager(buf))]
 
     @staticmethod
-    def prepare_read(entry: ObjectEntry) -> Tuple[List[ReadReq], Future]:
+    def prepare_read(
+        entry: ObjectEntry, logical_path: str = ""
+    ) -> Tuple[List[ReadReq], Future]:
         fut: Future = Future()
-        consumer = ObjectBufferConsumer(fut, nbytes=entry.nbytes or 0)
+        consumer = ObjectBufferConsumer(
+            fut,
+            nbytes=entry.nbytes or 0,
+            checksum=entry.checksum,
+            location=logical_path or entry.location,
+        )
         return [ReadReq(path=entry.location, buffer_consumer=consumer)], fut

@@ -151,7 +151,9 @@ class ShardedArrayIOPreparer:
                 write_reqs.append(
                     WriteReq(
                         path=loc,
-                        buffer_stager=ArrayBufferStager(data, is_async_snapshot),
+                        buffer_stager=ArrayBufferStager(
+                            data, is_async_snapshot, entry=tensor_entry
+                        ),
                     )
                 )
         entry = ShardedEntry(
@@ -164,6 +166,7 @@ class ShardedArrayIOPreparer:
         entry: ShardedEntry,
         obj_out: Any = None,
         buffer_size_limit_bytes: Optional[int] = None,
+        logical_path: str = "",
     ) -> Tuple[List[ReadReq], Future]:
         fut: Future = Future()
         global_shape = list(entry.shape)
@@ -194,7 +197,15 @@ class ShardedArrayIOPreparer:
                 ReadReq(
                     path=saved.tensor.location,
                     byte_range=byte_range,
-                    buffer_consumer=_ScatterConsumer(saved, overlaps, assembler),
+                    buffer_consumer=_ScatterConsumer(
+                        saved,
+                        overlaps,
+                        assembler,
+                        verify_location=(
+                            f"{logical_path or saved.tensor.location} "
+                            f"(shard @ {saved.offsets})"
+                        ),
+                    ),
                 )
             )
         assembler.total_reads = len(read_reqs)
@@ -347,10 +358,12 @@ class _ScatterConsumer(BufferConsumer):
         saved: ShardMeta,
         overlaps: List[Tuple[_Piece, Tuple[List[int], List[int]]]],
         assembler: _Assembler,
+        verify_location: str = "",
     ) -> None:
         self.saved = saved
         self.overlaps = overlaps
         self.assembler = assembler
+        self.verify_location = verify_location or saved.tensor.location
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -364,6 +377,9 @@ class _ScatterConsumer(BufferConsumer):
         self.assembler.read_landed()
 
     def _scatter(self, buf: BufferType) -> None:
+        from .array import _maybe_verify
+
+        _maybe_verify(buf, self.saved.tensor.checksum, self.verify_location)
         saved_arr = array_from_memoryview(
             memoryview(buf), self.saved.tensor.dtype, self.saved.sizes
         )

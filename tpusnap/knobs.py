@@ -22,6 +22,7 @@ _MEMORY_BUDGET_ENV_VAR = "TPUSNAP_MAX_PER_RANK_MEMORY_BUDGET_BYTES"
 _DISABLE_NATIVE_ENV_VAR = "TPUSNAP_DISABLE_NATIVE"
 _DISABLE_DIRECT_IO_ENV_VAR = "TPUSNAP_DISABLE_DIRECT_IO"
 _DISABLE_DONTCACHE_ENV_VAR = "TPUSNAP_DISABLE_DONTCACHE"
+_DISABLE_CHECKSUM_ENV_VAR = "TPUSNAP_DISABLE_CHECKSUM"
 _DIRECT_IO_QD_ENV_VAR = "TPUSNAP_DIRECT_IO_QD"
 _DIRECT_IO_CHUNK_ENV_VAR = "TPUSNAP_DIRECT_IO_CHUNK_BYTES"
 
@@ -80,6 +81,13 @@ def is_direct_io_disabled() -> bool:
     falls back to buffered writes automatically on filesystems without
     O_DIRECT support, so this knob exists for debugging/bench A-Bs."""
     return os.environ.get(_DISABLE_DIRECT_IO_ENV_VAR, "0") == "1"
+
+
+def is_checksum_disabled() -> bool:
+    """Per-blob CRC32C integrity checksums: recorded at stage time and
+    verified on read, both on by default. Disable for A/B benchmarking or
+    when reading snapshots from untrusted-layout sources only."""
+    return os.environ.get(_DISABLE_CHECKSUM_ENV_VAR, "0") == "1"
 
 
 def is_dontcache_disabled() -> bool:
@@ -162,4 +170,10 @@ def override_memory_budget_bytes(nbytes: int) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_direct_io_disabled(disabled: bool) -> Generator[None, None, None]:
     with _override_env(_DISABLE_DIRECT_IO_ENV_VAR, "1" if disabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_checksum_disabled(disabled: bool) -> Generator[None, None, None]:
+    with _override_env(_DISABLE_CHECKSUM_ENV_VAR, "1" if disabled else "0"):
         yield

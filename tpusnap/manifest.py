@@ -53,6 +53,11 @@ class TensorEntry(Entry):
     shape: List[int]
     replicated: bool
     byte_range: Optional[List[int]] = None  # [start, end) within location
+    # "<algo>:<8-hex>" over this entry's serialized bytes (its byte_range
+    # within location, or the whole blob). Recorded at stage time; verified
+    # on read unless TPUSNAP_DISABLE_CHECKSUM=1. Beyond the reference,
+    # which cannot detect a flipped bit on restore.
+    checksum: Optional[str] = None
 
     def __init__(
         self,
@@ -62,6 +67,7 @@ class TensorEntry(Entry):
         shape: Sequence[int],
         replicated: bool,
         byte_range: Optional[Sequence[int]] = None,
+        checksum: Optional[str] = None,
     ) -> None:
         super().__init__(type="Tensor")
         self.location = location
@@ -70,6 +76,7 @@ class TensorEntry(Entry):
         self.shape = list(shape)
         self.replicated = replicated
         self.byte_range = list(byte_range) if byte_range is not None else None
+        self.checksum = checksum
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "TensorEntry":
@@ -80,6 +87,7 @@ class TensorEntry(Entry):
             shape=d["shape"],
             replicated=d["replicated"],
             byte_range=d.get("byte_range"),
+            checksum=d.get("checksum"),
         )
 
 
@@ -176,6 +184,7 @@ class ObjectEntry(Entry):
     obj_type: str
     replicated: bool
     nbytes: Optional[int] = None  # serialized size; drives read memory budget
+    checksum: Optional[str] = None  # "<algo>:<8-hex>" (see TensorEntry)
 
     def __init__(
         self,
@@ -184,6 +193,7 @@ class ObjectEntry(Entry):
         obj_type: str,
         replicated: bool,
         nbytes: Optional[int] = None,
+        checksum: Optional[str] = None,
     ) -> None:
         super().__init__(type="object")
         self.location = location
@@ -191,6 +201,7 @@ class ObjectEntry(Entry):
         self.obj_type = obj_type
         self.replicated = replicated
         self.nbytes = nbytes
+        self.checksum = checksum
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ObjectEntry":
@@ -200,6 +211,7 @@ class ObjectEntry(Entry):
             obj_type=d["obj_type"],
             replicated=d["replicated"],
             nbytes=d.get("nbytes"),
+            checksum=d.get("checksum"),
         )
 
 

@@ -426,14 +426,19 @@ def _take_impl(
     entries_list, write_reqs = batch_write_requests(entries_list, write_reqs)
     entries = dict(zip(entries.keys(), entries_list))
 
-    global_manifest = _gather_manifest(entries, comm)
-    metadata = SnapshotMetadata(
-        version=__version__, world_size=comm.world_size, manifest=global_manifest
-    )
-
     memory_budget = get_process_memory_budget_bytes(comm)
     pending_io_work = sync_execute_write_reqs(
         write_reqs, storage, memory_budget, rank, event_loop
+    )
+    # The manifest is gathered AFTER staging completes (sync_execute
+    # returns at staging-complete; storage I/O may still be in flight):
+    # stagers record per-blob checksums into their entries at stage time,
+    # and those must land in the committed metadata. The reference
+    # gathers before scheduling (snapshot.py:842-853) only because its
+    # entries are final at prepare time.
+    global_manifest = _gather_manifest(entries, comm)
+    metadata = SnapshotMetadata(
+        version=__version__, world_size=comm.world_size, manifest=global_manifest
     )
     return pending_io_work, metadata
 
