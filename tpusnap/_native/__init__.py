@@ -372,7 +372,14 @@ def verify_checksum(buf, recorded: str, location: str) -> None:
         )
         return
     actual = crc32c(buf) & 0xFFFFFFFF
-    if actual != int(value, 16):
+    try:
+        recorded_value = int(value, 16)
+    except ValueError:
+        raise ChecksumError(
+            f"malformed checksum {recorded!r} recorded for {location!r} — "
+            "the snapshot metadata itself is corrupt"
+        ) from None
+    if actual != recorded_value:
         raise ChecksumError(
             f"checksum mismatch for {location!r}: stored {recorded}, "
             f"read bytes hash to {algo}:{actual:08x} — the blob was "

@@ -268,8 +268,14 @@ int ts_write_file_direct2(const char* path, const void* buf, size_t n,
   // Unaligned tail: a buffered positional write (offset need not be
   // block-aligned once the O_DIRECT fd is closed).
   if (aligned_n < n) {
+    // Don't leave a partial blob behind on failure, matching the
+    // ENOSPC and buffered-rewrite error paths above.
     int tfd = ::open(path, O_WRONLY);
-    if (tfd < 0) return -errno;
+    if (tfd < 0) {
+      int err = errno;
+      ::unlink(path);
+      return -err;
+    }
     const char* p = src + aligned_n;
     size_t remaining = n - aligned_n;
     off_t pos = static_cast<off_t>(aligned_n);
@@ -279,13 +285,18 @@ int ts_write_file_direct2(const char* path, const void* buf, size_t n,
         if (errno == EINTR) continue;
         int err = errno;
         ::close(tfd);
+        ::unlink(path);
         return -err;
       }
       p += w;
       pos += w;
       remaining -= static_cast<size_t>(w);
     }
-    if (::close(tfd) < 0) return -errno;
+    if (::close(tfd) < 0) {
+      int err = errno;
+      ::unlink(path);
+      return -err;
+    }
   }
   return 0;
 }

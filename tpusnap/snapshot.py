@@ -222,7 +222,10 @@ class Snapshot:
                     f"{path!r} is a container; read its leaves individually"
                 )
             read_reqs, fut = prepare_read(
-                entry, obj_out, buffer_size_limit_bytes=memory_budget_bytes
+                entry,
+                obj_out,
+                buffer_size_limit_bytes=memory_budget_bytes,
+                logical_path=logical_path,
             )
             budget = memory_budget_bytes or get_process_memory_budget_bytes(comm)
             sync_execute_read_reqs(read_reqs, storage, budget, comm.rank, event_loop)
@@ -500,7 +503,11 @@ def _load_stateful(
     for logical_path, entry in local_manifest.items():
         if is_container_entry(entry):
             continue
-        reqs, fut = prepare_read(entry, obj_out=target_flattened.get(logical_path))
+        reqs, fut = prepare_read(
+            entry,
+            obj_out=target_flattened.get(logical_path),
+            logical_path=logical_path,
+        )
         read_reqs.extend(reqs)
         futures[logical_path] = fut
 
