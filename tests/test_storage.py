@@ -4,9 +4,12 @@ plugin implicitly via Snapshot tests and tmp_path)."""
 import asyncio
 import os
 
+import numpy as np
 import pytest
 
+from tpusnap import Snapshot, StateDict
 from tpusnap.io_types import ReadIO, WriteIO
+from tpusnap.knobs import override_slab_size_threshold_bytes
 from tpusnap.storage_plugin import url_to_storage_plugin
 from tpusnap.storage_plugins.fs import FSStoragePlugin
 
@@ -218,3 +221,101 @@ def test_register_storage_plugin_runtime(tmp_path):
         unregister_storage_plugin("memtest")
     with pytest.raises(RuntimeError):
         url_to_storage_plugin("memtest://sub/dir")
+
+
+class TestReadInto:
+    """In-place reads: bytes land directly in the consumer-provided
+    destination with the checksum fused into the native copy-out."""
+
+    def test_read_range_into_correctness(self, tmp_path):
+        from tpusnap import _native
+
+        rng = np.random.default_rng(3)
+        n = 9 * 1024 * 1024 + 1234
+        data = rng.integers(0, 255, n, dtype=np.uint8).tobytes()
+        path = str(tmp_path / "blob")
+        open(path, "wb").write(data)
+        cases = [
+            (0, n),                       # whole file
+            (0, 5 * 1024 * 1024 + 17),    # aligned start, odd length
+            (1, n - 1),                   # misaligned head
+            (4096, 6 * 1024 * 1024),      # aligned window
+            (777, 8 * 1024 * 1024 + 5),   # misaligned head + tail
+            (n - 100, 100),               # small tail
+            (n - 100, 500),               # EOF-short
+            (0, 1000),                    # small (buffered path)
+        ]
+        for off, ln in cases:
+            out = np.empty(ln, dtype=np.uint8)
+            got, crc, algo = _native.read_range_into(
+                path, off, ln, out, want_crc=True
+            )
+            expect = data[off : off + ln]
+            assert got == len(expect), (off, ln)
+            assert out[:got].tobytes() == expect, (off, ln)
+            assert crc == _native.crc32c(expect), (off, ln)
+        # aligned destination takes the zero-copy direct path
+        out = _native.aligned_empty(8 * 1024 * 1024)
+        got, crc, algo = _native.read_range_into(
+            path, 0, 8 * 1024 * 1024, out, want_crc=True
+        )
+        assert got == 8 * 1024 * 1024
+        assert bytes(out) == data[:got] and crc == _native.crc32c(data[:got])
+        # want_crc=False reports no checksum
+        got, crc, algo = _native.read_range_into(
+            path, 0, 4 * 1024 * 1024, np.empty(4 * 1024 * 1024, np.uint8)
+        )
+        assert got == 4 * 1024 * 1024 and crc is None
+
+    def test_fs_plugin_honors_into(self, tmp_path):
+        plugin = FSStoragePlugin(root=str(tmp_path))
+        data = os.urandom(5 * 1024 * 1024)
+
+        async def go():
+            await plugin.write(WriteIO(path="b", buf=data))
+            dst = np.empty(len(data), dtype=np.uint8)
+            read_io = ReadIO(path="b", into=memoryview(dst), want_crc=True)
+            await plugin.read(read_io)
+            assert read_io.in_place
+            assert dst.tobytes() == data
+            from tpusnap import _native
+
+            if _native.available():
+                assert read_io.crc32c == _native.crc32c(data)
+                assert read_io.crc_algo == "crc32c"
+            # the generic buf view still works for fallback consumers
+            assert bytes(read_io.buf.getbuffer()) == data
+            await plugin.close()
+
+        _run(go())
+
+    def test_restore_lands_in_place(self, tmp_path):
+        """A numpy restore target with matching dtype/shape receives the
+        bytes directly — the future resolves to the SAME array object."""
+        arr = np.random.default_rng(5).standard_normal(500_000).astype(np.float32)
+        Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=arr.copy())})
+        target_arr = np.zeros_like(arr)
+        target = {"m": StateDict(w=target_arr)}
+        Snapshot(str(tmp_path / "s")).restore(target)
+        assert target["m"]["w"] is target_arr
+        assert np.array_equal(target_arr, arr)
+
+    def test_in_place_short_read_fails_loudly(self, tmp_path):
+        """A truncated blob must raise, not silently leave a partial
+        restore in the target — even with checksum verification off
+        (the truncated size disqualifies the in-place path, and the
+        generic deserialize raises on the size mismatch)."""
+        from tpusnap.knobs import override_checksum_disabled
+
+        arr = np.arange(300_000, dtype=np.float32)
+        with override_slab_size_threshold_bytes(1024):
+            Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=arr)})
+        blob = str(tmp_path / "s" / "0" / "m" / "w")
+        assert os.path.isfile(blob)
+        with open(blob, "r+b") as f:
+            f.truncate(arr.nbytes // 2)
+        for checksum_off in (False, True):
+            with override_checksum_disabled(checksum_off):
+                target = {"m": StateDict(w=np.zeros_like(arr))}
+                with pytest.raises((IOError, ValueError)):
+                    Snapshot(str(tmp_path / "s")).restore(target)

@@ -162,6 +162,16 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_size_t,
     ]
     lib.ts_read_range_direct2.restype = ctypes.c_int64
+    lib.ts_read_range_into_crc.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.ts_read_range_into_crc.restype = ctypes.c_int64
     lib.ts_memcpy_par.argtypes = [
         ctypes.c_void_p,
         ctypes.c_void_p,
@@ -303,6 +313,71 @@ def read_range(path: str, offset: int, n: int, out) -> int:
     return got
 
 
+def read_range_into(
+    path: str, offset: int, n: int, out, want_crc: bool = False
+) -> Tuple[int, Optional[int], str]:
+    """Ranged read landing directly in ``out`` (the restore target's own
+    memory), with the checksum fused into the bounce copy-out.
+
+    Returns ``(bytes_read, crc_or_None, algorithm)``. Compared to
+    ``read_range`` + a separate verify + a separate copy, this makes one
+    RAM-read + one RAM-write pass per byte total — the difference between
+    a CPU-ceiling-bound and a disk-bound restore on few-core hosts."""
+    mv = memoryview(out).cast("B")
+    if mv.readonly:
+        raise ValueError("out buffer must be writable")
+    if n > mv.nbytes:
+        raise ValueError(f"out buffer too small: {mv.nbytes} < {n}")
+    lib = _load()
+    if lib is None:
+        # readinto the destination directly — the in-place path's whole
+        # premise is that no full-size scratch buffer exists.
+        got = 0
+        with open(path, "rb") as f:
+            f.seek(offset)
+            while got < n:
+                r = f.readinto(mv[got:n])
+                if not r:
+                    break  # EOF
+                got += r
+        if want_crc:
+            import zlib
+
+            return got, zlib.crc32(mv[:got]), "zlib-crc32"
+        return got, None, "zlib-crc32"
+    if n == 0:
+        return 0, (crc32c(b"") if want_crc else None), "crc32c"
+    from ..knobs import (
+        get_direct_io_chunk_bytes,
+        get_direct_io_qd,
+        is_direct_io_disabled,
+    )
+
+    ptr, keepalive = _ptr(mv)
+    crc_out = ctypes.c_uint32(0)
+    if is_direct_io_disabled():
+        got = lib.ts_read_range(path.encode(), ptr, offset, n)
+        if got >= 0 and want_crc:
+            crc_val = lib.ts_crc32c(ptr, got, 0) if got else crc32c(b"")
+        else:
+            crc_val = None
+    else:
+        got = lib.ts_read_range_into_crc(
+            path.encode(),
+            ptr,
+            offset,
+            n,
+            get_direct_io_qd(),
+            get_direct_io_chunk_bytes(),
+            ctypes.byref(crc_out) if want_crc else None,
+        )
+        crc_val = crc_out.value if (want_crc and got >= 0) else None
+    del keepalive
+    if got < 0:
+        raise OSError(-got, os.strerror(-got), path)
+    return got, crc_val, "crc32c"
+
+
 def memcpy(dst, src, nthreads: int = 4) -> None:
     """GIL-released (and multi-threaded for large buffers) memcpy."""
     dst_mv = memoryview(dst).cast("B")
@@ -354,24 +429,26 @@ class ChecksumError(IOError):
     time — storage or transport corrupted the data."""
 
 
-def verify_checksum(buf, recorded: str, location: str) -> None:
-    """Verify a read buffer against the manifest-recorded checksum.
+def verify_checksum_value(
+    crc: int, algo: str, recorded: str, location: str
+) -> None:
+    """Verify a read-time-computed checksum value (from the fused native
+    read) against the manifest-recorded string — no data pass needed.
 
-    An algorithm mismatch (snapshot written by a build whose native
-    helper/fallback used a different polynomial) is skipped with a
-    warning — the bytes may be fine; only a same-algorithm mismatch is
-    proof of corruption."""
-    algo, _, value = recorded.partition(":")
-    if algo != checksum_algorithm():
+    Mirrors ``verify_checksum``'s algorithm-mismatch policy: a snapshot
+    written by a build with a different checksum implementation is skipped
+    with a warning; only a same-algorithm mismatch is proof of corruption.
+    """
+    rec_algo, _, value = recorded.partition(":")
+    if rec_algo != algo:
         logger.warning(
             "skipping checksum verification for %s: snapshot used %s, "
-            "this build computes %s",
+            "this read computed %s",
             location,
+            rec_algo,
             algo,
-            checksum_algorithm(),
         )
         return
-    actual = crc32c(buf) & 0xFFFFFFFF
     try:
         recorded_value = int(value, 16)
     except ValueError:
@@ -379,9 +456,24 @@ def verify_checksum(buf, recorded: str, location: str) -> None:
             f"malformed checksum {recorded!r} recorded for {location!r} — "
             "the snapshot metadata itself is corrupt"
         ) from None
-    if actual != recorded_value:
+    if (crc & 0xFFFFFFFF) != recorded_value:
         raise ChecksumError(
             f"checksum mismatch for {location!r}: stored {recorded}, "
-            f"read bytes hash to {algo}:{actual:08x} — the blob was "
-            "corrupted in storage or transit"
+            f"read bytes hash to {algo}:{crc & 0xFFFFFFFF:08x} — the blob "
+            "was corrupted in storage or transit"
         )
+
+
+def verify_checksum(buf, recorded: str, location: str) -> None:
+    """Verify a read buffer against the manifest-recorded checksum.
+
+    An algorithm mismatch (snapshot written by a build whose native
+    helper/fallback used a different polynomial) is skipped with a
+    warning — the bytes may be fine; only a same-algorithm mismatch is
+    proof of corruption."""
+    algo = checksum_algorithm()
+    if not recorded.startswith(algo + ":"):
+        # Defer hashing: nothing to compare against. Value 0 is unused.
+        verify_checksum_value(0, algo, recorded, location)
+        return
+    verify_checksum_value(crc32c(buf), algo, recorded, location)

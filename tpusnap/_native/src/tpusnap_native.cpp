@@ -83,6 +83,7 @@ int ts_write_file(const char* path, const void* buf, size_t n);
 int64_t ts_read_range(const char* path, void* out, int64_t offset, size_t n);
 int64_t ts_read_range_direct(const char* path, void* out, int64_t offset,
                              size_t n);
+uint32_t ts_crc32c(const void* buf, size_t n, uint32_t seed);
 
 // Returns 0 on success, -errno on failure.
 int ts_write_file(const char* path, const void* buf, size_t n) {
@@ -630,6 +631,222 @@ int64_t ts_read_range_direct(const char* path, void* out, int64_t offset,
     if (tail < 0) return tail;
     total += tail;
   }
+  return total;
+}
+
+// Fused read-into-destination with optional inline CRC32C.
+//
+// Restores on few-core hosts are CPU-ceiling-bound, not disk-bound: the
+// scratch-buffer pipeline costs one DMA + a checksum pass + a memcpy pass
+// per byte, all competing for the same cores as the storage interrupts.
+// This op reads [offset, offset+n) of `path` straight into the caller's
+// (arbitrarily aligned) destination and computes the checksum DURING the
+// bounce copy-out — sub-blocks sized to stay in L1, so the CRC pass reads
+// cache-hot bytes and RAM traffic is one read + one write per byte total.
+// The scheduler's consume stage then verifies a 4-byte value instead of
+// re-reading gigabytes.
+//
+// Engine choice mirrors ts_read_range_direct: O_DIRECT chunked preads
+// through bounce buffers (nthreads in flight, processed strictly in file
+// order because CRC32C is sequential), buffered fallback for small
+// ranges / unsupported filesystems / RAM-backed mounts, misaligned head
+// and tail via buffered preads. If the destination and file offset are
+// both block-aligned, the zero-copy direct reader is used instead and the
+// checksum (when requested) is one pass over the destination.
+//
+// Returns bytes read (short only at EOF) or -errno. *crc_out is written
+// only on success, and only when crc_out != NULL.
+
+static uint32_t ts_crccpy(char* dst, const char* src, size_t n, uint32_t crc,
+                          int want_crc) {
+  if (!want_crc) {
+    std::memcpy(dst, src, n);
+    return crc;
+  }
+  static const size_t kSub = 65536;  // L1/L2-resident sub-block
+  size_t off = 0;
+  while (off < n) {
+    const size_t len = (n - off < kSub) ? (n - off) : kSub;
+    // CRC the source sub-block first (brings it into cache), then copy
+    // the cache-hot bytes out: one RAM read + one RAM write per byte,
+    // and no store-to-load traffic on the just-written destination.
+    crc = ts_crc32c(src + off, len, crc);
+    std::memcpy(dst + off, src + off, len);
+    off += len;
+  }
+  return crc;
+}
+
+static int64_t read_into_buffered_crc(const char* path, void* out,
+                                      int64_t offset, size_t n,
+                                      uint32_t* crc_out) {
+  int64_t got = ts_read_range(path, out, offset, n);
+  if (got < 0) return got;
+  if (crc_out != nullptr)
+    *crc_out = ts_crc32c(out, static_cast<size_t>(got), 0);
+  return got;
+}
+
+int64_t ts_read_range_into_crc(const char* path, void* out, int64_t offset,
+                               size_t n, int nthreads, size_t chunk,
+                               uint32_t* crc_out) {
+  static const int64_t kAlign = 4096;
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > 8) nthreads = 8;
+  // Bounce memory here is invisible to the scheduler's budget; cap it.
+  if (chunk < (1u << 20)) chunk = 1u << 20;
+  if (chunk > (8u << 20)) chunk = 8u << 20;
+  chunk &= ~(static_cast<size_t>(kAlign) - 1);
+  if (O_DIRECT == 0 || n < (4u << 20))
+    return read_into_buffered_crc(path, out, offset, n, crc_out);
+  if (reinterpret_cast<uintptr_t>(out) % kAlign == 0 && offset % kAlign == 0) {
+    int64_t got = ts_read_range_direct2(path, out, offset, n, nthreads,
+                                        chunk * 4);
+    if (got < 0) return got;
+    if (crc_out != nullptr)
+      *crc_out = ts_crc32c(out, static_cast<size_t>(got), 0);
+    return got;
+  }
+  int fd = ::open(path, O_RDONLY | O_DIRECT, 0);
+  if (fd < 0) return read_into_buffered_crc(path, out, offset, n, crc_out);
+  if (is_ram_backed(fd)) {
+    ::close(fd);
+    return read_into_buffered_crc(path, out, offset, n, crc_out);
+  }
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return read_into_buffered_crc(path, out, offset, n, crc_out);
+  }
+  const int64_t file_size = st.st_size;
+  const int64_t req_end =
+      (offset + static_cast<int64_t>(n) < file_size)
+          ? offset + static_cast<int64_t>(n)
+          : file_size;
+  if (req_end <= offset) {
+    ::close(fd);
+    if (crc_out != nullptr) *crc_out = ts_crc32c(out, 0, 0);
+    return 0;
+  }
+  const int64_t a_start = (offset + kAlign - 1) & ~(kAlign - 1);
+  const int64_t a_end = req_end & ~(kAlign - 1);
+  if (a_end <= a_start) {
+    ::close(fd);
+    return read_into_buffered_crc(path, out, offset, n, crc_out);
+  }
+
+  // Don't allocate more bounce memory than the window needs: a small
+  // (e.g. budget-tile) read must not pin (nthreads+1) full chunks.
+  const int64_t window = a_end - a_start;
+  if (static_cast<int64_t>(chunk) > window)
+    chunk = static_cast<size_t>(window);  // window is block-aligned
+  const int64_t n_chunks =
+      (window + static_cast<int64_t>(chunk) - 1) / static_cast<int64_t>(chunk);
+  const int nbufs =
+      (n_chunks < nthreads + 1) ? static_cast<int>(n_chunks) : nthreads + 1;
+  std::vector<void*> bounce(nbufs, nullptr);
+  for (int i = 0; i < nbufs; ++i) {
+    if (::posix_memalign(&bounce[i], kAlign, chunk) != 0) {
+      for (void* b : bounce) std::free(b);
+      ::close(fd);
+      return read_into_buffered_crc(path, out, offset, n, crc_out);
+    }
+  }
+
+  char* dst = static_cast<char*>(out);
+  const int want_crc = crc_out != nullptr;
+  uint32_t crc = 0;
+  bool failed = false;
+  bool short_read = false;
+
+  // Misaligned head via buffered pread (CRC is sequential, so the head
+  // must be hashed before the first aligned chunk).
+  if (a_start > offset) {
+    int64_t head = ts_read_range(path, dst, offset,
+                                 static_cast<size_t>(a_start - offset));
+    if (head < 0 || head < a_start - offset) failed = true;
+    if (!failed && want_crc)
+      crc = ts_crc32c(dst, static_cast<size_t>(a_start - offset), crc);
+  }
+
+  if (!failed) {
+    // nthreads chunk preads in flight; the main thread drains them in
+    // strict file order, fusing the bounce->dst copy with the CRC.
+    struct Inflight {
+      std::thread thread;
+      int buf_idx;
+      int64_t pos;
+      int64_t len;
+    };
+    std::vector<std::atomic<int64_t>> results(nbufs);
+    std::deque<Inflight> inflight;
+    std::deque<int> free_bufs;
+    for (int i = 0; i < nbufs; ++i) free_bufs.push_back(i);
+    int64_t pos = a_start;
+    while ((pos < a_end || !inflight.empty()) && !failed && !short_read) {
+      while (pos < a_end && !free_bufs.empty() &&
+             static_cast<int>(inflight.size()) < nthreads) {
+        const int bi = free_bufs.front();
+        free_bufs.pop_front();
+        const int64_t len = (a_end - pos < static_cast<int64_t>(chunk))
+                                ? (a_end - pos)
+                                : static_cast<int64_t>(chunk);
+        char* buf = static_cast<char*>(bounce[bi]);
+        std::atomic<int64_t>* slot = &results[bi];
+        inflight.push_back(Inflight{
+            std::thread([fd, buf, len, pos, slot] {
+              int64_t done = 0;
+              while (done < len) {
+                ssize_t got =
+                    ::pread(fd, buf + done, len - done, pos + done);
+                if (got < 0) {
+                  if (errno == EINTR) continue;
+                  slot->store(-static_cast<int64_t>(errno));
+                  return;
+                }
+                if (got == 0) break;  // file shrank under us
+                done += got;
+              }
+              slot->store(done);
+            }),
+            bi, pos, len});
+        pos += len;
+      }
+      Inflight f = std::move(inflight.front());
+      inflight.pop_front();
+      f.thread.join();
+      const int64_t got = results[f.buf_idx].load();
+      if (got < 0) {
+        failed = true;
+      } else {
+        crc = ts_crccpy(dst + (f.pos - offset),
+                        static_cast<char*>(bounce[f.buf_idx]),
+                        static_cast<size_t>(got), crc, want_crc);
+        if (got < f.len) short_read = true;
+      }
+      free_bufs.push_back(f.buf_idx);
+    }
+    for (auto& rem : inflight) rem.thread.join();
+  }
+
+  for (void* b : bounce) std::free(b);
+  ::close(fd);
+  // A short direct read means the file changed size mid-read; re-read the
+  // whole range through the simple buffered path for a consistent result.
+  if (failed || short_read)
+    return read_into_buffered_crc(path, out, offset, n, crc_out);
+
+  // Tail ([a_end, req_end)) via buffered pread.
+  int64_t total = a_end - offset;
+  if (req_end > a_end) {
+    int64_t tail = ts_read_range(path, dst + (a_end - offset), a_end,
+                                 static_cast<size_t>(req_end - a_end));
+    if (tail < 0) return tail;
+    if (want_crc)
+      crc = ts_crc32c(dst + (a_end - offset), static_cast<size_t>(tail), crc);
+    total += tail;
+  }
+  if (crc_out != nullptr) *crc_out = crc;
   return total;
 }
 

@@ -130,10 +130,46 @@ def _may_alias_live_memory(arr: ArrayLike, host: np.ndarray) -> bool:
     return True
 
 
+def writable_byte_view(
+    arr: Optional[ArrayLike], dtype: str, shape: Sequence[int]
+) -> Optional[memoryview]:
+    """Flat writable byte view over ``arr`` when the stored blob's bytes
+    may land there verbatim: numpy, writable, C-contiguous, exact
+    dtype/shape match. Used as the destination of in-place reads — the
+    storage plugin DMAs straight into the restore target and the
+    deserialize+copy pass disappears."""
+    if not isinstance(arr, np.ndarray):
+        return None
+    if not (arr.flags.writeable and arr.flags.c_contiguous):
+        return None
+    try:
+        if dtype_to_string(arr.dtype) != dtype or list(arr.shape) != list(shape):
+            return None
+    except ValueError:
+        return None
+    try:
+        mv = array_as_memoryview(arr)
+    except ValueError:
+        return None
+    # array_as_memoryview copies non-contiguous inputs; contiguity was
+    # checked above, so this view aliases arr's memory.
+    return mv if not mv.readonly else None
+
+
+def _want_crc(entry: TensorEntry) -> bool:
+    from ..knobs import is_checksum_disabled
+
+    return entry.checksum is not None and not is_checksum_disabled()
+
+
 class ArrayBufferConsumer(BufferConsumer):
     """Deserializes into the restore target. For jax targets the result is
     device_put with the target's sharding; numpy targets are filled in
-    place (the reference's in-place load, tensor.py:188-196)."""
+    place (the reference's in-place load, tensor.py:188-196) — and when
+    the storage plugin supports it, the read lands in the target's own
+    memory with the checksum computed inside the read (``consume_read_io``
+    then verifies a 4-byte value and the consume stage does no data pass
+    at all)."""
 
     def __init__(
         self,
@@ -146,6 +182,27 @@ class ArrayBufferConsumer(BufferConsumer):
         self.obj_out = obj_out
         self.fut = fut
         self.verify_location = verify_location or entry.location
+        self.into_mv = writable_byte_view(obj_out, entry.dtype, entry.shape)
+
+    async def consume_read_io(self, read_io, executor: Optional[Executor] = None) -> None:
+        if read_io.in_place:
+            self._finalize_in_place(read_io)
+            return
+        await self.consume_buffer(read_io.buf.getbuffer(), executor)
+
+    def _finalize_in_place(self, read_io) -> None:
+        # Bytes are already in obj_out's memory; only verify the read-time
+        # checksum against the manifest (an int compare, no data pass).
+        if self.entry.checksum is not None and read_io.crc32c is not None:
+            from .. import _native
+
+            _native.verify_checksum_value(
+                read_io.crc32c,
+                read_io.crc_algo,
+                self.entry.checksum,
+                self.verify_location,
+            )
+        self.fut.obj = self.obj_out
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -247,13 +304,16 @@ class ArrayIOPreparer:
                 entry, obj_out, buffer_size_limit_bytes, fut
             )
         byte_range = tuple(entry.byte_range) if entry.byte_range is not None else None
+        consumer = ArrayBufferConsumer(
+            entry, obj_out, fut, verify_location=logical_path
+        )
         read_reqs = [
             ReadReq(
                 path=entry.location,
                 byte_range=byte_range,
-                buffer_consumer=ArrayBufferConsumer(
-                    entry, obj_out, fut, verify_location=logical_path
-                ),
+                buffer_consumer=consumer,
+                into=consumer.into_mv,
+                want_crc=consumer.into_mv is not None and _want_crc(entry),
             )
         ]
         return read_reqs, fut
@@ -299,13 +359,15 @@ class ArrayIOPreparer:
             r1 = min(r0 + rows_per_tile, n_rows)
             start = base_offset + r0 * row_nbytes
             end = base_offset + r1 * row_nbytes
+            consumer = _TileConsumer(
+                entry, host_out, r0, r1, remaining, fut, obj_out, in_place
+            )
             read_reqs.append(
                 ReadReq(
                     path=entry.location,
                     byte_range=(start, end),
-                    buffer_consumer=_TileConsumer(
-                        entry, host_out, r0, r1, remaining, fut, obj_out, in_place
-                    ),
+                    buffer_consumer=consumer,
+                    into=consumer.into_mv,
                 )
             )
         return read_reqs, fut
@@ -337,6 +399,33 @@ class _TileConsumer(BufferConsumer):
         # the whole-blob checksum cannot verify.
         self.blob_checksum = blob_checksum
         self.blob_location = blob_location
+        # The tile's destination rows are contiguous in host_out, so the
+        # read may land there directly (host_out is freshly allocated or
+        # already validated as an exact-match target).
+        row_slice = host_out[self.r0 : self.r1]
+        mv = (
+            array_as_memoryview(row_slice)
+            if row_slice.flags.c_contiguous and row_slice.flags.writeable
+            else None
+        )
+        # Zero-byte slices come back as a read-only memoryview(b"").
+        self.into_mv = mv if mv is not None and not mv.readonly else None
+
+    async def consume_read_io(self, read_io, executor: Optional[Executor] = None) -> None:
+        if read_io.in_place:
+            if self.blob_checksum is not None and read_io.crc32c is not None:
+                from .. import _native
+
+                _native.verify_checksum_value(
+                    read_io.crc32c,
+                    read_io.crc_algo,
+                    self.blob_checksum,
+                    self.blob_location,
+                )
+        else:
+            await self.consume_buffer(read_io.buf.getbuffer(), executor)
+            return
+        self._after_consume()
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -346,6 +435,9 @@ class _TileConsumer(BufferConsumer):
             await loop.run_in_executor(executor, self._consume_blocking, buf)
         else:
             self._consume_blocking(buf)
+        self._after_consume()
+
+    def _after_consume(self) -> None:
         # Completion bookkeeping stays on the event-loop thread — the
         # executor runs up to 4 consumers concurrently and a bare
         # read-modify-write there can lose decrements.

@@ -20,7 +20,13 @@ from ..io_types import Future, ReadReq, WriteReq
 from ..knobs import get_max_chunk_size_bytes
 from ..manifest import Chunk, ChunkedTensorEntry, TensorEntry
 from ..serialization import Serializer, dtype_to_string, string_to_dtype, tensor_nbytes
-from .array import ArrayBufferStager, ArrayIOPreparer, _TileConsumer, array_nbytes
+from .array import (
+    ArrayBufferStager,
+    ArrayIOPreparer,
+    _TileConsumer,
+    _want_crc,
+    array_nbytes,
+)
 
 
 def should_chunk(arr) -> bool:
@@ -119,29 +125,33 @@ class ChunkedArrayIOPreparer:
                 if tensor_entry.byte_range is not None
                 else None
             )
+            consumer = _TileConsumer(
+                # _TileConsumer tiles over rows of `shape`; a chunk is
+                # exactly a row range, so it is reused as-is.
+                _chunk_as_full_entry(entry, chunk),
+                host_out,
+                r0,
+                r1,
+                remaining,
+                fut,
+                obj_out,
+                in_place,
+                # Each chunk read covers one complete stored blob,
+                # so the chunk's whole-blob checksum is verifiable.
+                blob_checksum=tensor_entry.checksum,
+                blob_location=(
+                    f"{logical_path or tensor_entry.location} "
+                    f"(chunk @ row {r0})"
+                ),
+            )
             read_reqs.append(
                 ReadReq(
                     path=tensor_entry.location,
                     byte_range=byte_range,
-                    buffer_consumer=_TileConsumer(
-                        # _TileConsumer tiles over rows of `shape`; a chunk is
-                        # exactly a row range, so it is reused as-is.
-                        _chunk_as_full_entry(entry, chunk),
-                        host_out,
-                        r0,
-                        r1,
-                        remaining,
-                        fut,
-                        obj_out,
-                        in_place,
-                        # Each chunk read covers one complete stored blob,
-                        # so the chunk's whole-blob checksum is verifiable.
-                        blob_checksum=tensor_entry.checksum,
-                        blob_location=(
-                            f"{logical_path or tensor_entry.location} "
-                            f"(chunk @ row {r0})"
-                        ),
-                    ),
+                    buffer_consumer=consumer,
+                    into=consumer.into_mv,
+                    want_crc=consumer.into_mv is not None
+                    and _want_crc(tensor_entry),
                 )
             )
         return read_reqs, fut

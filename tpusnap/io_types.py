@@ -48,6 +48,19 @@ class ReadIO:
     path: str
     byte_range: Optional[Tuple[int, int]] = None
     buf: io.BytesIO = field(default_factory=io.BytesIO)
+    # In-place read support: when ``into`` is set, a capable plugin may
+    # land the bytes directly in this writable buffer (the restore
+    # target's own memory) instead of allocating a scratch buffer, and
+    # set ``in_place=True``. With ``want_crc``, the plugin also reports
+    # the checksum of the bytes it delivered (computed inside the native
+    # read, fused with the copy-out) via ``crc32c``/``crc_algo`` so the
+    # consumer verifies a 4-byte value instead of re-hashing gigabytes.
+    # Plugins without in-place support simply ignore these fields.
+    into: Optional[memoryview] = None
+    want_crc: bool = False
+    in_place: bool = False
+    crc32c: Optional[int] = None
+    crc_algo: Optional[str] = None
 
 
 class BufferStager(abc.ABC):
@@ -77,17 +90,37 @@ class BufferConsumer(abc.ABC):
     def get_consuming_cost_bytes(self) -> int:
         """Peak host memory consumed while this buffer is being consumed."""
 
+    async def consume_read_io(
+        self, read_io: ReadIO, executor: Optional[Executor] = None
+    ) -> None:
+        """Consume a completed ReadIO. The default path hands the read
+        buffer to ``consume_buffer``; consumers whose reads may land
+        in place override this to skip the deserialize+copy pass when
+        ``read_io.in_place`` is set."""
+        await self.consume_buffer(read_io.buf.getbuffer(), executor)
+
 
 @dataclass
 class ReadReq:
     path: str
     buffer_consumer: BufferConsumer
     byte_range: Optional[Tuple[int, int]] = None
+    # Writable destination for plugins that support in-place reads (the
+    # restore target's memory when the consumer knows landing there is
+    # correct); see ReadIO.into. ``want_crc`` requests the fused
+    # read-time checksum of the delivered bytes.
+    into: Optional[memoryview] = None
+    want_crc: bool = False
 
 
 class StoragePlugin(abc.ABC):
     """Storage backend. Implementations must be safe for many concurrent
     coroutines (the scheduler keeps up to 16 requests in flight)."""
+
+    # Plugins that honor ReadIO.into (bytes land in the consumer-provided
+    # destination, no scratch buffer) set this True; the scheduler then
+    # exempts such reads from the consuming-memory budget.
+    supports_in_place_reads: bool = False
 
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None: ...
