@@ -33,18 +33,21 @@ def test_tiled_read_correct_and_budgeted(big_snapshot):
         )
     assert np.array_equal(out, arr)
     # Prove the tiled path ran: one 96 MiB tensor under an 8 MiB budget
-    # must split into many byte-ranged tile reads, not one dense read.
+    # must split into byte-ranged tile reads, not one dense read. Tiles
+    # align UP to the 16 MiB checksum-tile boundary (integrity over
+    # budget), so the floor is nbytes / 16 MiB reads.
     from tpusnap.scheduler import LAST_EXECUTION_STATS
 
-    assert LAST_EXECUTION_STATS["read"]["reqs"] >= 10
+    assert LAST_EXECUTION_STATS["read"]["reqs"] >= arr.nbytes // (16 * MB)
     # Peak transient RSS beyond the (unavoidable) full-size destination
-    # must stay near the budget: destination + concurrent in-flight tiles.
-    # The scheduler keeps <= budget of tiles in flight plus one always-
-    # allowed over-budget item; 4x headroom still catches the failure mode
-    # (a second full 96 MiB copy).
+    # must stay near the effective tile size: destination + in-flight
+    # tiles (the scheduler admits <= budget of tiles plus one always-
+    # allowed over-budget item; tiles here are one 16 MiB checksum tile).
+    # The bound still catches the failure mode (a second full 96 MiB
+    # copy).
     peak = max(rss_deltas, default=0)
-    assert peak < arr.nbytes + 4 * budget, (
-        f"peak RSS delta {peak / MB:.0f} MiB exceeds destination+4x budget"
+    assert peak < arr.nbytes + 6 * budget, (
+        f"peak RSS delta {peak / MB:.0f} MiB exceeds destination+6x budget"
     )
 
 

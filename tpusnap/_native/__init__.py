@@ -181,6 +181,12 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.ts_memcpy_par.restype = None
     lib.ts_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32]
     lib.ts_crc32c.restype = ctypes.c_uint32
+    lib.ts_crc32c_combine.argtypes = [
+        ctypes.c_uint32,
+        ctypes.c_uint32,
+        ctypes.c_uint64,
+    ]
+    lib.ts_crc32c_combine.restype = ctypes.c_uint32
 
 
 def available() -> bool:
@@ -413,6 +419,55 @@ def crc32c(buf, seed: int = 0) -> int:
     out = lib.ts_crc32c(ptr, mv.nbytes, seed)
     del keepalive
     return out
+
+
+def crc_combine(crc1: int, crc2: int, len2: int) -> int:
+    """CRC of a concatenation A||B from crc(A), crc(B), len(B) —
+    O(log len2), no data pass. Uses whichever polynomial this build's
+    ``crc32c`` computes (CRC32C native / CRC-32 zlib fallback), so
+    combined values are always comparable to directly-computed ones."""
+    lib = _load()
+    if lib is not None:
+        return lib.ts_crc32c_combine(crc1 & 0xFFFFFFFF, crc2 & 0xFFFFFFFF, len2)
+    return _crc_combine_py(crc1, crc2, len2, poly=0xEDB88320)
+
+
+def _crc_combine_py(crc1: int, crc2: int, len2: int, poly: int) -> int:
+    """Pure-Python GF(2) combine (zlib crc32_combine algorithm)."""
+    if len2 == 0:
+        return crc1 & 0xFFFFFFFF
+
+    def times(mat, vec):
+        s = 0
+        i = 0
+        while vec:
+            if vec & 1:
+                s ^= mat[i]
+            vec >>= 1
+            i += 1
+        return s
+
+    def square(mat):
+        return [times(mat, mat[n]) for n in range(32)]
+
+    odd = [poly] + [1 << n for n in range(31)]
+    even = square(odd)
+    odd = square(even)
+    crc1 &= 0xFFFFFFFF
+    while True:
+        even = square(odd)
+        if len2 & 1:
+            crc1 = times(even, crc1)
+        len2 >>= 1
+        if not len2:
+            break
+        odd = square(even)
+        if len2 & 1:
+            crc1 = times(odd, crc1)
+        len2 >>= 1
+        if not len2:
+            break
+    return (crc1 ^ crc2) & 0xFFFFFFFF
 
 
 def checksum_algorithm() -> str:

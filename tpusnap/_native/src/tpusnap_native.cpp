@@ -922,4 +922,49 @@ uint32_t ts_crc32c(const void* buf, size_t n, uint32_t seed) {
 #endif
 }
 
+// CRC32C combine (zlib crc32_combine adapted to the Castagnoli
+// polynomial): crc of a concatenation A||B from crc(A), crc(B), len(B),
+// in O(log len2) GF(2) matrix operations. Lets the stager hash a blob
+// ONCE at tile granularity and still record the whole-blob checksum, and
+// lets tile-aligned partial reads be verified by combining recorded tile
+// checksums — no second hash pass anywhere.
+static uint32_t gf2_matrix_times(const uint32_t* mat, uint32_t vec) {
+  uint32_t sum = 0;
+  int i = 0;
+  while (vec) {
+    if (vec & 1) sum ^= mat[i];
+    vec >>= 1;
+    ++i;
+  }
+  return sum;
+}
+
+static void gf2_matrix_square(uint32_t* square, const uint32_t* mat) {
+  for (int n = 0; n < 32; ++n) square[n] = gf2_matrix_times(mat, mat[n]);
+}
+
+uint32_t ts_crc32c_combine(uint32_t crc1, uint32_t crc2, uint64_t len2) {
+  uint32_t even[32];
+  uint32_t odd[32];
+  if (len2 == 0) return crc1;
+  odd[0] = 0x82f63b78u;  // CRC32C (Castagnoli), reflected
+  uint32_t row = 1;
+  for (int n = 1; n < 32; ++n) {
+    odd[n] = row;
+    row <<= 1;
+  }
+  gf2_matrix_square(even, odd);
+  gf2_matrix_square(odd, even);
+  do {
+    gf2_matrix_square(even, odd);
+    if (len2 & 1) crc1 = gf2_matrix_times(even, crc1);
+    len2 >>= 1;
+    if (!len2) break;
+    gf2_matrix_square(odd, even);
+    if (len2 & 1) crc1 = gf2_matrix_times(odd, crc1);
+    len2 >>= 1;
+  } while (len2);
+  return crc1 ^ crc2;
+}
+
 }  // extern "C"

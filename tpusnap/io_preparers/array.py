@@ -103,9 +103,7 @@ class ArrayBufferStager(BufferStager):
         host = np.asarray(self.arr)  # DtoH (no-op if DMA already done)
         mv = array_as_memoryview(host)
         if self.entry is not None and not is_checksum_disabled():
-            from .. import _native
-
-            self.entry.checksum = _native.checksum_string(mv)
+            _record_checksums(self.entry, mv)
         if self.is_async_snapshot and _may_alias_live_memory(self.arr, host):
             # Defensive clone: training resumes before I/O completes, and a
             # donated buffer could be overwritten under us. The native
@@ -160,6 +158,82 @@ def _want_crc(entry: TensorEntry) -> bool:
     from ..knobs import is_checksum_disabled
 
     return entry.checksum is not None and not is_checksum_disabled()
+
+
+def _record_checksums(entry: TensorEntry, mv: memoryview) -> None:
+    """Record integrity checksums into ``entry`` at stage time.
+
+    Blobs large enough to be read under a memory budget are hashed in
+    row-tiles (``tile_rows``/``tile_checksums``) and the whole-blob value
+    derived by CRC combine — one hash pass either way. Budget-tiled
+    reads align to these boundaries and verify by combining the covered
+    tiles' values (beyond the reference, which has no end-to-end
+    integrity checking at all)."""
+    from .. import _native
+    from ..knobs import get_tile_checksum_bytes
+
+    shape = entry.shape
+    n_rows = shape[0] if shape else 0
+    row_nbytes = mv.nbytes // n_rows if n_rows else 0
+    tile_rows = (
+        max(1, get_tile_checksum_bytes() // row_nbytes) if row_nbytes else 0
+    )
+    if n_rows > tile_rows >= 1:
+        algo = _native.checksum_algorithm()
+        tiles: List[str] = []
+        combined: Optional[int] = None
+        for r0 in range(0, n_rows, tile_rows):
+            r1 = min(r0 + tile_rows, n_rows)
+            sub = mv[r0 * row_nbytes : r1 * row_nbytes]
+            crc = _native.crc32c(sub) & 0xFFFFFFFF
+            tiles.append(f"{algo}:{crc:08x}")
+            combined = (
+                crc
+                if combined is None
+                else _native.crc_combine(combined, crc, sub.nbytes)
+            )
+        entry.tile_rows = tile_rows
+        entry.tile_checksums = tiles
+        entry.checksum = f"{algo}:{combined & 0xFFFFFFFF:08x}"
+    else:
+        entry.checksum = _native.checksum_string(mv)
+
+
+def combined_tile_checksum(
+    entry: TensorEntry, r0: int, r1: int, row_nbytes: int
+) -> Optional[str]:
+    """Expected checksum of rows [r0, r1) derived from recorded tile
+    checksums, or None when the range is not verifiable (no tiles
+    recorded, boundaries misaligned, or the snapshot was written by a
+    build with a different checksum algorithm — combining with the wrong
+    polynomial would manufacture false corruption reports)."""
+    from .. import _native
+
+    t = entry.tile_rows
+    if not entry.tile_checksums or not t:
+        return None
+    n_rows = entry.shape[0]
+    if r0 % t != 0 or (r1 != n_rows and r1 % t != 0):
+        return None
+    algo = _native.checksum_algorithm()
+    combined: Optional[int] = None
+    for i in range(r0 // t, math.ceil(r1 / t)):
+        tile = entry.tile_checksums[i]
+        tile_algo, _, value = tile.partition(":")
+        if tile_algo != algo:
+            return None
+        try:
+            crc = int(value, 16)
+        except ValueError:
+            return None
+        tr1 = min((i + 1) * t, n_rows)
+        nb = (tr1 - i * t) * row_nbytes
+        combined = (
+            crc if combined is None else _native.crc_combine(combined, crc, nb)
+        )
+    if combined is None:
+        return None
+    return f"{algo}:{combined & 0xFFFFFFFF:08x}"
 
 
 class ArrayBufferConsumer(BufferConsumer):
@@ -223,9 +297,10 @@ class ArrayBufferConsumer(BufferConsumer):
 
 
 def _maybe_verify(buf: BufferType, checksum: Optional[str], location: str) -> None:
-    """Verify a full-blob read against the manifest checksum (knob-gated).
-    Callers reading a sub-range of an entry's bytes (budget tiles) must
-    pass checksum=None — the recorded value covers the whole entry."""
+    """Verify a read buffer against a manifest checksum (knob-gated).
+    Callers reading a sub-range of an entry's bytes pass the combined
+    tile checksum for that range (``combined_tile_checksum``), or None
+    when the range is not verifiable."""
     if checksum is None:
         return
     from ..knobs import is_checksum_disabled
@@ -301,7 +376,7 @@ class ArrayIOPreparer:
             and entry.shape[0] > 1
         ):
             return ArrayIOPreparer._prepare_tiled_read(
-                entry, obj_out, buffer_size_limit_bytes, fut
+                entry, obj_out, buffer_size_limit_bytes, fut, logical_path
             )
         byte_range = tuple(entry.byte_range) if entry.byte_range is not None else None
         consumer = ArrayBufferConsumer(
@@ -324,17 +399,37 @@ class ArrayIOPreparer:
         obj_out: Optional[ArrayLike],
         buffer_size_limit_bytes: int,
         fut: Future,
+        logical_path: str = "",
     ) -> Tuple[List[ReadReq], Future]:
         """Split one tensor read into byte-ranged row tiles so peak host
         memory stays under the budget (reference tensor.py:126-179).
 
         The tiles are copied into one preallocated host array; the future
-        resolves when the last tile lands.
+        resolves when the last tile lands. When the entry carries
+        tile-grain checksums, read tiles are aligned to the recorded
+        boundaries and each verified against the combined tile values —
+        memory-budgeted reads detect corruption like whole-blob reads do.
         """
         shape = entry.shape
         row_nbytes = tensor_nbytes(entry.dtype, shape[1:]) if len(shape) > 1 else tensor_nbytes(entry.dtype, [1])
         rows_per_tile = max(1, buffer_size_limit_bytes // max(row_nbytes, 1))
         n_rows = shape[0]
+        from ..knobs import is_checksum_disabled
+
+        verify_tiles = (
+            bool(entry.tile_checksums and entry.tile_rows)
+            and not is_checksum_disabled()
+        )
+        if verify_tiles:
+            if rows_per_tile >= entry.tile_rows:
+                # Round down to a multiple of the checksum tile.
+                rows_per_tile = (
+                    rows_per_tile // entry.tile_rows
+                ) * entry.tile_rows
+            else:
+                # Integrity over budget: the recorded tile is the minimum
+                # verifiable read unit (16 MiB-class by default).
+                rows_per_tile = entry.tile_rows
 
         # Preallocated host destination; tiles land in place.
         if isinstance(obj_out, np.ndarray) and (
@@ -359,8 +454,24 @@ class ArrayIOPreparer:
             r1 = min(r0 + rows_per_tile, n_rows)
             start = base_offset + r0 * row_nbytes
             end = base_offset + r1 * row_nbytes
+            tile_checksum = (
+                combined_tile_checksum(entry, r0, r1, row_nbytes)
+                if verify_tiles
+                else None
+            )
             consumer = _TileConsumer(
-                entry, host_out, r0, r1, remaining, fut, obj_out, in_place
+                entry,
+                host_out,
+                r0,
+                r1,
+                remaining,
+                fut,
+                obj_out,
+                in_place,
+                blob_checksum=tile_checksum,
+                blob_location=(
+                    f"{logical_path or entry.location} (rows {r0}:{r1})"
+                ),
             )
             read_reqs.append(
                 ReadReq(
@@ -368,6 +479,8 @@ class ArrayIOPreparer:
                     byte_range=(start, end),
                     buffer_consumer=consumer,
                     into=consumer.into_mv,
+                    want_crc=consumer.into_mv is not None
+                    and tile_checksum is not None,
                 )
             )
         return read_reqs, fut
@@ -394,9 +507,10 @@ class _TileConsumer(BufferConsumer):
         self.fut = fut
         self.obj_out = obj_out
         self.in_place = in_place
-        # Set only when this consumer's read covers a complete stored blob
-        # (chunked reads); budget tiles read sub-ranges of one blob, which
-        # the whole-blob checksum cannot verify.
+        # The checksum this read range is verifiable against: the chunk's
+        # whole-blob value for chunked reads, or the combined tile value
+        # for budget tiles aligned to recorded checksum-tile boundaries
+        # (None when the range is unverifiable or verification is off).
         self.blob_checksum = blob_checksum
         self.blob_location = blob_location
         # The tile's destination rows are contiguous in host_out, so the

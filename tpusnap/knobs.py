@@ -25,6 +25,7 @@ _DISABLE_DONTCACHE_ENV_VAR = "TPUSNAP_DISABLE_DONTCACHE"
 _DISABLE_CHECKSUM_ENV_VAR = "TPUSNAP_DISABLE_CHECKSUM"
 _DIRECT_IO_QD_ENV_VAR = "TPUSNAP_DIRECT_IO_QD"
 _DIRECT_IO_CHUNK_ENV_VAR = "TPUSNAP_DIRECT_IO_CHUNK_BYTES"
+_TILE_CHECKSUM_ENV_VAR = "TPUSNAP_TILE_CHECKSUM_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -33,6 +34,9 @@ _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES = 128 * 1024 * 1024
 # QD 2 x 32 MiB out-runs single-in-flight 8 MiB by ~30% aggregate).
 _DEFAULT_DIRECT_IO_QD = 2
 _DEFAULT_DIRECT_IO_CHUNK_BYTES = 32 * 1024 * 1024
+# Row-tile granularity for tile-grain checksums on large dense blobs
+# (the verifiable unit of memory-budgeted partial reads).
+_DEFAULT_TILE_CHECKSUM_BYTES = 16 * 1024 * 1024
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -106,6 +110,10 @@ def get_direct_io_chunk_bytes() -> int:
     return _get_int_env(
         _DIRECT_IO_CHUNK_ENV_VAR, _DEFAULT_DIRECT_IO_CHUNK_BYTES
     )
+
+
+def get_tile_checksum_bytes() -> int:
+    return _get_int_env(_TILE_CHECKSUM_ENV_VAR, _DEFAULT_TILE_CHECKSUM_BYTES)
 
 
 def get_memory_budget_override_bytes() -> Optional[int]:

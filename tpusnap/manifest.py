@@ -58,6 +58,14 @@ class TensorEntry(Entry):
     # on read unless TPUSNAP_DISABLE_CHECKSUM=1. Beyond the reference,
     # which cannot detect a flipped bit on restore.
     checksum: Optional[str] = None
+    # Tile-grain checksums for memory-budgeted partial reads: the blob is
+    # hashed ONCE at stage time in row-tiles of ``tile_rows`` rows; the
+    # whole-blob ``checksum`` is derived by CRC combine. Budget-tiled
+    # reads align to these boundaries and verify each read range by
+    # combining the covered tiles' values — so the huge-tensor-under-
+    # budget path detects corruption too, at no extra hash pass anywhere.
+    tile_rows: Optional[int] = None
+    tile_checksums: Optional[List[str]] = None
 
     def __init__(
         self,
@@ -68,6 +76,8 @@ class TensorEntry(Entry):
         replicated: bool,
         byte_range: Optional[Sequence[int]] = None,
         checksum: Optional[str] = None,
+        tile_rows: Optional[int] = None,
+        tile_checksums: Optional[Sequence[str]] = None,
     ) -> None:
         super().__init__(type="Tensor")
         self.location = location
@@ -77,6 +87,10 @@ class TensorEntry(Entry):
         self.replicated = replicated
         self.byte_range = list(byte_range) if byte_range is not None else None
         self.checksum = checksum
+        self.tile_rows = tile_rows
+        self.tile_checksums = (
+            list(tile_checksums) if tile_checksums is not None else None
+        )
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "TensorEntry":
@@ -88,6 +102,8 @@ class TensorEntry(Entry):
             replicated=d["replicated"],
             byte_range=d.get("byte_range"),
             checksum=d.get("checksum"),
+            tile_rows=d.get("tile_rows"),
+            tile_checksums=d.get("tile_checksums"),
         )
 
 
