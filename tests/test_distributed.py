@@ -365,3 +365,133 @@ def test_elastic_upscale_restore():
         run_subprocess_world(
             _world_elastic_restore, world_size=3, args=[f"{d}/snap", "restore"]
         )
+
+
+def _world_collective_count(snap_dir):
+    """Assert take's coalesced collective structure: exactly 2 gathers
+    (pre-staging coalesce + manifest) + 2 barriers (two-phase commit),
+    NO broadcasts; restore and read_object issue ZERO collectives here
+    because take's gather already cached the memory-budget divisor in
+    this process (a cold restore in a fresh process pays exactly one
+    hostname gather)."""
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict
+    from tpusnap.comm import Communicator, get_communicator
+
+    class CountingComm(Communicator):
+        def __init__(self, inner):
+            self.inner = inner
+            self.counts = {"barrier": 0, "all_gather": 0, "broadcast": 0}
+
+        @property
+        def rank(self):
+            return self.inner.rank
+
+        @property
+        def world_size(self):
+            return self.inner.world_size
+
+        def barrier(self):
+            self.counts["barrier"] += 1
+            self.inner.barrier()
+
+        def all_gather_object(self, obj):
+            self.counts["all_gather"] += 1
+            return self.inner.all_gather_object(obj)
+
+        def broadcast_object(self, obj, src=0):
+            self.counts["broadcast"] += 1
+            return self.inner.broadcast_object(obj, src)
+
+    comm = CountingComm(get_communicator())
+    state = StateDict(
+        w=np.arange(4096, dtype=np.float32),
+        b=np.ones(64, dtype=np.float32) * comm.rank,
+        step=7,
+    )
+    Snapshot.take(snap_dir, {"m": state}, replicated=["m/w"], comm=comm)
+    assert comm.counts == {"barrier": 2, "all_gather": 2, "broadcast": 0}, (
+        comm.counts
+    )
+
+    restore_comm = CountingComm(get_communicator())
+    dst = {
+        "m": StateDict(
+            w=np.zeros(4096, np.float32), b=np.zeros(64, np.float32), step=0
+        )
+    }
+    Snapshot(snap_dir, comm=restore_comm).restore(dst)
+    assert restore_comm.counts == {
+        "barrier": 0,
+        "all_gather": 0,
+        "broadcast": 0,
+    }, restore_comm.counts
+    assert dst["m"]["step"] == 7
+    np.testing.assert_array_equal(dst["m"]["b"], np.ones(64) * comm.rank)
+
+    out = Snapshot(snap_dir, comm=restore_comm).read_object("0/m/w")
+    np.testing.assert_array_equal(out, np.arange(4096, dtype=np.float32))
+    assert restore_comm.counts["all_gather"] == 0, restore_comm.counts
+
+    # per_key_barrier=True restores the reference's safety mode: one
+    # extra key gather + one barrier per key.
+    safety_comm = CountingComm(get_communicator())
+    Snapshot.take(
+        f"{snap_dir}_pkb",
+        {"m": state},
+        replicated=["m/w"],
+        comm=safety_comm,
+        per_key_barrier=True,
+    )
+    assert safety_comm.counts["all_gather"] == 3, safety_comm.counts
+    assert safety_comm.counts["barrier"] == 3, safety_comm.counts
+
+
+def test_collective_count_world8():
+    """World-8: the coalesced comm structure holds at (modest) scale and
+    each collective is O(1) KV RPCs per rank (one set + one barrier +
+    one dir-get), so take cost no longer grows with world size."""
+    with tempfile.TemporaryDirectory() as d:
+        run_subprocess_world(
+            _world_collective_count,
+            world_size=8,
+            devices_per_process=1,
+            args=[f"{d}/snap"],
+        )
+
+
+def _world_interleaved_communicators():
+    """Two Communicator instances used in DIFFERENT relative orders on
+    different ranks must not cross-wire values (the process-global
+    sequence this replaces silently swapped payloads here)."""
+    from tpusnap.comm import JaxCoordinationComm, get_communicator
+
+    base = get_communicator()
+    rank = base.rank
+    comm_a = JaxCoordinationComm(namespace="test_a")
+    comm_b = JaxCoordinationComm(namespace="test_b")
+
+    if rank == 0:
+        # A first, then B.
+        comm_a.broadcast_object("from-A", src=0)
+        comm_b.broadcast_object("from-B", src=0)
+    else:
+        # B first, then A — divergent cross-instance order.
+        got_b = comm_b.broadcast_object(None, src=0)
+        got_a = comm_a.broadcast_object(None, src=0)
+        assert got_b == "from-B", got_b
+        assert got_a == "from-A", got_a
+    base.barrier()
+
+    # Interleaved gathers on both instances still route correctly.
+    ga = comm_a.all_gather_object(("a", rank))
+    gb = comm_b.all_gather_object(("b", rank * 10))
+    assert ga == [("a", r) for r in range(base.world_size)], ga
+    assert gb == [("b", r * 10) for r in range(base.world_size)], gb
+
+
+def test_interleaved_communicator_instances():
+    run_subprocess_world(
+        _world_interleaved_communicators, world_size=2, devices_per_process=1
+    )

@@ -18,10 +18,22 @@ auto-detects the environment: single process → no-op collectives; a live
 collectives. Detection reads the coordination state directly so that
 checkpointing host-resident state never initializes a device backend.
 
-Sequencing: every collective bumps a process-global sequence number.
-Ranks execute the same collectives in the same order (SPMD), so the
-sequence numbers agree across ranks and key collisions are impossible;
-keys are deleted after a trailing barrier.
+Sequencing: keys are namespaced per Communicator *instance* (assigned
+lazily at the first collective from a process-global counter — ranks
+issue their first collective on instances in the same order under SPMD,
+while collective-free construction on rank subsets stays free) and
+sequenced per instance, so two interleaved Communicator instances can
+never cross-wire keys. Within one instance, ranks must execute the same
+collectives in the same order — the same contract as any collective
+backend.
+
+Scalability: ``all_gather_object`` is one KV set + one barrier + one
+``key_value_dir_get`` per rank — O(1) RPCs regardless of world size
+(the reference pays one torch.dist gather; the naive KV port paid
+world_size serial gets). ``broadcast_object`` is one set / one blocking
+get with NO barrier. Consumed keys are garbage-collected lazily: rank 0
+deletes a collective's prefix only after a later barrier proves every
+rank has moved past it.
 """
 
 from __future__ import annotations
@@ -58,19 +70,23 @@ class Communicator:
         return obj
 
 
-_seq = 0
+_instance_count = 0
 
 
-def _next_seq() -> int:
-    global _seq
-    _seq += 1
-    return _seq
+def _next_instance() -> int:
+    global _instance_count
+    _instance_count += 1
+    return _instance_count
 
 
 class JaxCoordinationComm(Communicator):
     """KV-store-backed collectives for multi-process jobs."""
 
-    def __init__(self, timeout_ms: int = _DEFAULT_TIMEOUT_MS) -> None:
+    def __init__(
+        self,
+        timeout_ms: int = _DEFAULT_TIMEOUT_MS,
+        namespace: Optional[str] = None,
+    ) -> None:
         from jax._src import distributed
 
         client = distributed.global_state.client
@@ -87,6 +103,21 @@ class JaxCoordinationComm(Communicator):
         self._rank = distributed.global_state.process_id
         self._world_size = distributed.global_state.num_processes
         self._timeout_ms = timeout_ms
+        # Keys are namespaced per instance so interleaved use of two
+        # Communicator objects cannot cross-wire. Auto namespaces are
+        # assigned LAZILY at the first collective — constructing a
+        # communicator for collective-free work (restore, read_object)
+        # on a subset of ranks must not desync the counter that makes
+        # namespaces agree across ranks. Ranks must issue their FIRST
+        # collective on instances in the same order (SPMD); pass
+        # ``namespace`` explicitly when that order may diverge.
+        self._ns: Optional[str] = (
+            f"tpusnap/{namespace}" if namespace is not None else None
+        )
+        self._seq = 0
+        # Prefixes fully consumed on this rank, deletable (by rank 0)
+        # once a later barrier proves every rank has moved past them.
+        self._gc_pending: List[str] = []
 
     @property
     def rank(self) -> int:
@@ -96,32 +127,63 @@ class JaxCoordinationComm(Communicator):
     def world_size(self) -> int:
         return self._world_size
 
-    def barrier(self) -> None:
-        seq = _next_seq()
-        self._client.wait_at_barrier(f"tpusnap_b{seq}", timeout_in_ms=self._timeout_ms)
+    def _namespace(self) -> str:
+        if self._ns is None:
+            self._ns = f"tpusnap/i{_next_instance()}"
+        return self._ns
 
-    def all_gather_object(self, obj: Any) -> List[Any]:
-        seq = _next_seq()
-        prefix = f"tpusnap/ag{seq}"
-        self._client.key_value_set(f"{prefix}/{self._rank}", _encode(obj))
-        out = []
-        for r in range(self._world_size):
-            raw = self._client.blocking_key_value_get(
-                f"{prefix}/{r}", self._timeout_ms
-            )
-            out.append(_decode(raw))
-        # Everyone has read every key; rank 0 garbage-collects the prefix.
-        self.barrier()
-        if self._rank == 0:
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _flush_gc(self) -> None:
+        """Delete prefixes whose consumption a barrier just proved global.
+        Called only immediately after a successful wait_at_barrier."""
+        if self._rank != 0:
+            self._gc_pending.clear()
+            return
+        for prefix in self._gc_pending:
             try:
-                self._client.key_value_delete(prefix + "/")
+                self._client.key_value_delete(prefix)
             except Exception:
                 pass
-        return out
+        self._gc_pending.clear()
+
+    def barrier(self) -> None:
+        seq = self._next_seq()
+        self._client.wait_at_barrier(
+            f"{self._namespace()}_b{seq}".replace("/", "_"),
+            timeout_in_ms=self._timeout_ms,
+        )
+        self._flush_gc()
+
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        """One KV set + one barrier + ONE dir-get — O(1) RPCs per rank
+        regardless of world size (the per-rank serial gets of the naive
+        port serialized take/restore at scale)."""
+        seq = self._next_seq()
+        prefix = f"{self._namespace()}/ag{seq}"
+        self._client.key_value_set(f"{prefix}/{self._rank}", _encode(obj))
+        # The barrier guarantees every rank's key is written (and lets
+        # rank 0 GC prefixes from earlier collectives).
+        self.barrier()
+        entries = self._client.key_value_dir_get(prefix)
+        by_rank = {}
+        for key, raw in entries:
+            by_rank[int(key.rsplit("/", 1)[-1])] = raw
+        if len(by_rank) != self._world_size:
+            raise RuntimeError(
+                f"all_gather {prefix!r}: expected {self._world_size} "
+                f"entries, got {sorted(by_rank)}"
+            )
+        self._gc_pending.append(prefix + "/")
+        return [_decode(by_rank[r]) for r in range(self._world_size)]
 
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:
-        seq = _next_seq()
-        key = f"tpusnap/bc{seq}"
+        """One set (src) / one blocking get (others); no barrier. The key
+        is GC'd after a later barrier proves global consumption."""
+        seq = self._next_seq()
+        key = f"{self._namespace()}/bc{seq}"
         if self._rank == src:
             self._client.key_value_set(key, _encode(obj))
             result = obj
@@ -129,12 +191,8 @@ class JaxCoordinationComm(Communicator):
             result = _decode(
                 self._client.blocking_key_value_get(key, self._timeout_ms)
             )
-        self.barrier()
-        if self._rank == src:
-            try:
-                self._client.key_value_delete(key)
-            except Exception:
-                pass
+        if self._rank == 0:
+            self._gc_pending.append(key)
         return result
 
 

@@ -7,10 +7,12 @@ exactly one rank, chosen greedily so total write load balances:
 
 - units: one per replicated entry; chunked tensors subpartition per-chunk
   (reference :42-79);
-- per-rank starting load = that rank's non-replicated write bytes
-  (all-gathered, reference :122-129);
-- rank 0 assigns each unit (largest first) to the currently least-loaded
-  rank and broadcasts the assignment (reference :144);
+- per-rank starting load = that rank's non-replicated write bytes,
+  estimated collective-free before prepare so it rides take's single
+  pre-staging gather (reference all-gathers separately, :122-129);
+- EVERY rank runs the same deterministic argmin-greedy assignment on the
+  identical gathered inputs — the reference's rank-0-compute + broadcast
+  (reference :144) is one more collective for no benefit;
 - each rank keeps only the write requests assigned to it. Manifest
   consolidation picks the writer's entry version (which may have been
   slab-batched) — see ``consolidate_replicated_entries``.
@@ -19,78 +21,167 @@ exactly one rank, chosen greedily so total write load balances:
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Dict, List, Optional, Tuple
 
-from .comm import Communicator
 from .knobs import is_partitioner_disabled
 from .manifest import ChunkedTensorEntry, Entry, Manifest, is_replicated
 from .io_types import WriteReq
 
 logger = logging.getLogger(__name__)
 
-# A unit key is either a logical path (atomic entries) or
-# (logical_path, chunk_location) for per-chunk units.
-UnitKey = Union[str, Tuple[str, str]]
 
+def estimate_write_loads(
+    flattened: Dict[str, object], replicated_candidates: List[str]
+) -> Tuple[List[Tuple[str, int]], int]:
+    """Pre-prepare, collective-free load estimation for this rank.
 
-def _collect_units(
-    entries: Manifest, replicated_paths: List[str], write_req_costs: Dict[str, int]
-) -> List[Tuple[UnitKey, List[str], int]]:
-    """[(unit_key, [write_req_path], load_bytes)] for replicated entries."""
-    units: List[Tuple[UnitKey, List[str], int]] = []
-    for logical_path in replicated_paths:
-        entry = entries[logical_path]
-        if isinstance(entry, ChunkedTensorEntry):
-            for chunk in entry.chunks:
-                loc = chunk.tensor.location
+    Returns ``(replicated_units, base_load)``: one ``(unit_id, cost)``
+    per replicated candidate (chunked arrays subpartition per chunk,
+    unit id ``"path::<chunk_idx>"``), and the rank's non-replicated
+    write bytes. Costs mirror what the preparers will produce — array
+    nbytes, chunk-grain splits, sys.getsizeof for pickled objects (the
+    reference's own approximation, object.py:76-78) — so every rank can
+    run the same deterministic assignment on the gathered results with
+    NO extra collective and NO broadcast."""
+    import sys as _sys
+
+    import jax
+    import numpy as np
+
+    from .io_preparers.chunked import chunk_row_ranges, should_chunk
+    from .io_preparers.sharded import is_sharded
+    from .manifest import PrimitiveEntry
+    from .serialization import dtype_to_string, tensor_nbytes
+
+    candidates = set(replicated_candidates)
+    units: List[Tuple[str, int]] = []
+    base_load = 0
+    for path in sorted(flattened):
+        leaf = flattened[path]
+        if PrimitiveEntry.supported(leaf):
+            # Inlined in metadata, no write load — but a replicated
+            # primitive still needs a zero-cost unit so the intersection
+            # marks its entry replicated (manifest dedup onto rank 0).
+            if path in candidates:
+                units.append((path, 0))
+            continue
+        is_array = isinstance(leaf, (jax.Array, np.ndarray))
+        if is_array and isinstance(leaf, jax.Array) and is_sharded(leaf):
+            # Sharded entries are never replicated-partitioned; their
+            # local shards are this rank's own load.
+            try:
+                base_load += sum(
+                    s.data.nbytes for s in leaf.addressable_shards
+                )
+            except Exception:
+                pass
+            continue
+        if is_array:
+            try:
+                dtype = dtype_to_string(leaf.dtype)
+                nbytes = tensor_nbytes(dtype, list(leaf.shape))
+            except ValueError:
+                nbytes = _sys.getsizeof(leaf)
+                dtype = None
+        else:
+            nbytes = _sys.getsizeof(leaf)
+            dtype = None
+        if path not in candidates:
+            base_load += nbytes
+            continue
+        if is_array and dtype is not None and should_chunk(leaf):
+            for i, (r0, r1) in enumerate(
+                chunk_row_ranges(list(leaf.shape), dtype, _max_chunk())
+            ):
                 units.append(
-                    ((logical_path, loc), [loc], write_req_costs.get(loc, 0))
+                    (f"{path}::{i}", tensor_nbytes(dtype, [r1 - r0] + list(leaf.shape[1:])))
                 )
         else:
-            loc = getattr(entry, "location", None)
-            if loc is None:
-                continue
-            units.append((logical_path, [loc], write_req_costs.get(loc, 0)))
-    return units
+            units.append((path, nbytes))
+    return units, base_load
 
 
-def partition_write_reqs(
+def _max_chunk() -> int:
+    from .knobs import get_max_chunk_size_bytes
+
+    return get_max_chunk_size_bytes()
+
+
+def assign_replicated_units(
+    per_rank_units: List[List[Tuple[str, int]]],
+    per_rank_base_loads: List[int],
+    unit_valid=None,
+) -> Tuple[Dict[str, int], set]:
+    """Deterministic partition plan from gathered per-rank estimates.
+
+    A unit is partitionable only when EVERY rank listed it (the
+    replicated-path intersection, reference snapshot.py:605-638) and
+    ``unit_valid`` (if given) accepts it; a rank's non-common candidates
+    fall back into its base load since it will write them itself. Every
+    rank computes the identical plan — argmin-greedy over identical
+    gathered inputs is deterministic — so no broadcast is needed.
+
+    Returns ``(assignment, common_paths)``: unit_id -> writer rank, and
+    the set of logical paths whose entries are replicated on all ranks.
+    """
+    unit_sets = [{u for u, _ in units} for units in per_rank_units]
+    common = set.intersection(*unit_sets) if unit_sets else set()
+    if unit_valid is not None:
+        common = {u for u in common if unit_valid(u)}
+    loads = list(per_rank_base_loads)
+    for r, units in enumerate(per_rank_units):
+        loads[r] += sum(cost for u, cost in units if u not in common)
+    # Costs of common units are identical across ranks (same bytes);
+    # take them from rank 0's list.
+    costs = {u: c for u, c in per_rank_units[0] if u in common}
+    shaped_units = [(u, [u], costs[u]) for u in sorted(common)]
+    assignment = _greedy_assign(shaped_units, loads)
+    common_paths = {u.split("::", 1)[0] for u in common}
+    return assignment, common_paths
+
+
+def filter_assigned_write_reqs(
     entries: Manifest,
     write_reqs: List[WriteReq],
     replicated_paths: List[str],
-    comm: Communicator,
+    assignment: Dict[str, int],
+    rank: int,
 ) -> List[WriteReq]:
     """Drop replicated write requests not assigned to this rank. Entries
     are left untouched (locations are rank-agnostic)."""
-    if comm.world_size == 1 or not replicated_paths or is_partitioner_disabled():
+    if not replicated_paths or is_partitioner_disabled():
         return write_reqs
+    keep_paths = set()
+    replicated_req_paths = set()
 
-    write_req_costs = {
-        wr.path: wr.buffer_stager.get_staging_cost_bytes() for wr in write_reqs
-    }
-    units = _collect_units(entries, sorted(replicated_paths), write_req_costs)
-    replicated_req_paths = {p for _, paths, _ in units for p in paths}
+    def decide(unit_id: str, location: str) -> None:
+        replicated_req_paths.add(location)
+        writer = assignment.get(unit_id)
+        if writer is None:
+            # A unit of a replicated-marked path missing from the plan
+            # means the estimate and the prepared entry disagree (e.g.
+            # ranks saw different shapes). Write it ourselves — a
+            # duplicate write of identical bytes is harmless, a blob the
+            # manifest references but nobody wrote corrupts the snapshot.
+            logger.warning(
+                "replicated unit %r (blob %r) is not in the partition "
+                "plan; writing it on every rank",
+                unit_id,
+                location,
+            )
+            keep_paths.add(location)
+        elif writer == rank:
+            keep_paths.add(location)
 
-    # Starting load: this rank's non-replicated write bytes.
-    own_load = sum(
-        cost
-        for path, cost in write_req_costs.items()
-        if path not in replicated_req_paths
-    )
-    all_loads = comm.all_gather_object(own_load)
-
-    if comm.rank == 0:
-        assignment = _greedy_assign(units, all_loads)
-    else:
-        assignment = None
-    assignment = comm.broadcast_object(assignment, src=0)
-
-    keep_paths = {
-        path
-        for (unit_key, paths, _) in units
-        for path in paths
-        if assignment[_unit_id(unit_key)] == comm.rank
-    }
+    for logical_path in sorted(replicated_paths):
+        entry = entries[logical_path]
+        if isinstance(entry, ChunkedTensorEntry):
+            for i, chunk in enumerate(entry.chunks):
+                decide(f"{logical_path}::{i}", chunk.tensor.location)
+        else:
+            loc = getattr(entry, "location", None)
+            if loc is not None:
+                decide(logical_path, loc)
     return [
         wr
         for wr in write_reqs
@@ -98,20 +189,18 @@ def partition_write_reqs(
     ]
 
 
-def _unit_id(unit_key: UnitKey) -> str:
-    return unit_key if isinstance(unit_key, str) else f"{unit_key[0]}::{unit_key[1]}"
 
 
 def _greedy_assign(
-    units: List[Tuple[UnitKey, List[str], int]], loads: List[int]
+    units: List[Tuple[str, List[str], int]], loads: List[int]
 ) -> Dict[str, int]:
     """Largest-first argmin-greedy assignment (reference :42-79)."""
     loads = list(loads)
     assignment: Dict[str, int] = {}
-    for unit_key, _, cost in sorted(units, key=lambda u: u[2], reverse=True):
+    for unit_id, _, cost in sorted(units, key=lambda u: u[2], reverse=True):
         target = min(range(len(loads)), key=lambda r: loads[r])
         loads[target] += cost
-        assignment[_unit_id(unit_key)] = target
+        assignment[unit_id] = target
     return assignment
 
 

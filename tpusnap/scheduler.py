@@ -57,15 +57,31 @@ _AVAILABLE_MEMORY_FRACTION = 0.6
 _REPORT_INTERVAL_SEC = 10.0
 
 
-def get_process_memory_budget_bytes(comm=None) -> int:
+# local_world_size is stable for the life of a job; cache it so restore
+# and read_object never pay a collective for it (take threads it through
+# explicitly from its coalescing gather).
+_cached_local_world_size: Optional[int] = None
+
+
+def get_process_memory_budget_bytes(
+    comm=None, local_world_size: Optional[int] = None
+) -> int:
     """Per-process host-memory budget for staging/consuming buffers
-    (reference scheduler.py:45-65)."""
+    (reference scheduler.py:45-65). ``local_world_size`` (ranks sharing
+    this host) may be passed by callers that already gathered hostnames;
+    otherwise it is discovered once per process and cached."""
+    global _cached_local_world_size
     override = get_memory_budget_override_bytes()
     if override is not None:
         return override
-    if comm is not None and comm.world_size > 1:
+    if local_world_size is not None:
+        _cached_local_world_size = local_world_size
+    elif _cached_local_world_size is not None:
+        local_world_size = _cached_local_world_size
+    elif comm is not None and comm.world_size > 1:
         hostnames = comm.all_gather_object(socket.gethostname())
         local_world_size = hostnames.count(socket.gethostname())
+        _cached_local_world_size = local_world_size
     else:
         local_world_size = 1
     available = psutil.virtual_memory().available

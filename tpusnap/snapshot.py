@@ -24,9 +24,10 @@ Preserved semantics (call stacks in SURVEY.md §3):
 
 TPU-first deltas: replication is **inferred from shardings** — a
 fully-replicated multi-process ``jax.Array`` is provably identical on
-every rank, so it is deduplicated automatically without the reference's
-DDP-module introspection (snapshot.py:791-807); the glob API is kept for
-host-side values (numpy arrays, primitives) where no sharding exists.
+every rank, so the sharded preparer's replica-0 dedup stores one copy
+automatically without the reference's DDP-module introspection
+(snapshot.py:791-807); the glob API is kept for host-side values
+(numpy arrays, primitives) where no sharding exists.
 """
 
 from __future__ import annotations
@@ -91,23 +92,26 @@ class Snapshot:
         replicated: Optional[List[str]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
         comm: Optional[Communicator] = None,
+        per_key_barrier: bool = False,
     ) -> "Snapshot":
+        """``per_key_barrier=True`` restores the reference's barrier
+        between every stateful's ``state_dict()`` call (snapshot.py:
+        362-368) — needed only when a stateful runs its own collectives
+        inside ``state_dict`` and those must not interleave across keys.
+        tpusnap itself issues no device collectives during take, so the
+        default skips the barriers (and their extra key gather)."""
         comm = get_communicator(comm)
         event_loop = asyncio.new_event_loop()
         try:
-            path, replicated = _coalesce_path_and_replicated(
-                path, replicated or [], comm
-            )
-            storage = url_to_storage_plugin_in_event_loop(
-                path, event_loop, storage_options
-            )
-            pending_io_work, metadata = _take_impl(
+            pending_io_work, metadata, path, storage = _take_impl(
+                path=path,
                 app_state=app_state,
-                storage=storage,
+                storage_options=storage_options,
                 comm=comm,
-                replicated=replicated,
+                replicated=replicated or [],
                 event_loop=event_loop,
                 is_async_snapshot=False,
+                per_key_barrier=per_key_barrier,
             )
             pending_io_work.sync_complete(event_loop)
             comm.barrier()
@@ -129,18 +133,19 @@ class Snapshot:
         replicated: Optional[List[str]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
         comm: Optional[Communicator] = None,
+        per_key_barrier: bool = False,
     ) -> "PendingSnapshot":
         comm = get_communicator(comm)
         event_loop = asyncio.new_event_loop()
-        path, replicated = _coalesce_path_and_replicated(path, replicated or [], comm)
-        storage = url_to_storage_plugin_in_event_loop(path, event_loop, storage_options)
-        pending_io_work, metadata = _take_impl(
+        pending_io_work, metadata, path, storage = _take_impl(
+            path=path,
             app_state=app_state,
-            storage=storage,
+            storage_options=storage_options,
             comm=comm,
-            replicated=replicated,
+            replicated=replicated or [],
             event_loop=event_loop,
             is_async_snapshot=True,
+            per_key_barrier=per_key_barrier,
         )
         # Control returns to training here: staging is complete, the
         # snapshot content is frozen; only storage I/O remains.
@@ -156,7 +161,20 @@ class Snapshot:
 
     # --------------------------------------------------------------- restore
 
-    def restore(self, app_state: AppState) -> None:
+    def restore(self, app_state: AppState, per_key_barrier: bool = False) -> None:
+        """Each rank restores its own manifest view independently — the
+        default restore issues no barriers and no per-key collectives
+        (the snapshot is immutable and every rank reads storage
+        directly; the reference barriers once per key,
+        snapshot.py:459-470, which at 16+ processes x many keys is pure
+        serial KV overhead). The one exception: a fresh process gathers
+        hostnames ONCE to size the memory budget (cached thereafter; a
+        take in the same process pre-populates it) — so all ranks must
+        enter a cold restore together, as they do on any SPMD restart.
+
+        ``per_key_barrier=True`` restores the reference's global key
+        order + barrier-per-key — needed only when a stateful runs its
+        own collectives inside ``load_state_dict``."""
         comm = get_communicator(self._comm)
         _validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
@@ -167,14 +185,19 @@ class Snapshot:
             metadata = self._get_metadata(storage, event_loop)
             memory_budget = get_process_memory_budget_bytes(comm)
 
-            global_keys = _gather_keys(comm, sorted(app_state.keys()))
+            multi = comm.world_size > 1
+            if per_key_barrier and multi:
+                keys = _gather_keys(comm, sorted(app_state.keys()))
+            else:
+                keys = sorted(app_state.keys())
             # RNG state is restored last so that loading other statefuls
             # cannot perturb it (reference snapshot.py:473-481).
             rng_keys = [
-                k for k in global_keys if isinstance(app_state.get(k), RNGState)
+                k for k in keys if isinstance(app_state.get(k), RNGState)
             ]
-            for key in [k for k in global_keys if k not in rng_keys] + rng_keys:
-                comm.barrier()
+            for key in [k for k in keys if k not in rng_keys] + rng_keys:
+                if per_key_barrier and multi:
+                    comm.barrier()
                 stateful = app_state.get(key)
                 if stateful is None:
                     continue
@@ -291,34 +314,6 @@ def _validate_app_state(app_state: AppState) -> None:
             )
 
 
-def _coalesce_path_and_replicated(
-    path: str, replicated: List[str], comm: Communicator
-):
-    """Rank 0's path wins (with a warning on divergence); replicated globs
-    are intersected across ranks (reference snapshot.py:752-812)."""
-    if comm.world_size == 1:
-        return path, list(replicated)
-    rank0_path = comm.broadcast_object(path, src=0)
-    if rank0_path != path:
-        logger.warning(
-            "Rank %d's snapshot path %r differs from rank 0's %r; using rank 0's",
-            comm.rank,
-            path,
-            rank0_path,
-        )
-    all_globs = comm.all_gather_object(sorted(set(replicated)))
-    common = set(all_globs[0])
-    for globs in all_globs[1:]:
-        common &= set(globs)
-    dropped = set(replicated) - common
-    if dropped:
-        logger.warning(
-            "Replicated globs %s were not specified on every rank; ignoring",
-            sorted(dropped),
-        )
-    return rank0_path, sorted(common)
-
-
 def _gather_keys(comm: Communicator, local_keys: List[str]) -> List[str]:
     if comm.world_size == 1:
         return sorted(local_keys)
@@ -329,42 +324,36 @@ def _gather_keys(comm: Communicator, local_keys: List[str]) -> List[str]:
     return sorted(merged)
 
 
-def _infer_replicated_leaf(leaf: Any, world_size: int) -> bool:
-    """A fully-replicated multi-process jax.Array is identical on every
-    rank by construction — dedup its writes automatically."""
-    if world_size <= 1 or not isinstance(leaf, jax.Array):
-        return False
-    return leaf.is_fully_replicated and not leaf.is_fully_addressable
-
-
-def _calculate_replicated_paths(
-    flattened_paths: List[str], replicated_globs: List[str], comm: Communicator
-) -> Set[str]:
-    """Glob-matched paths present on ALL ranks (reference :605-638)."""
-    matched = [
-        p
-        for p in flattened_paths
-        if any(fnmatch.fnmatch(p, g) for g in replicated_globs)
-    ]
-    if comm.world_size == 1:
-        return set(matched)
-    gathered = comm.all_gather_object(sorted(matched))
-    common = set(gathered[0])
-    for paths in gathered[1:]:
-        common &= set(paths)
-    return common
-
-
 def _take_impl(
+    path: str,
     app_state: AppState,
-    storage: StoragePlugin,
+    storage_options: Optional[Dict[str, Any]],
     comm: Communicator,
     replicated: List[str],
     event_loop: asyncio.AbstractEventLoop,
     is_async_snapshot: bool,
+    per_key_barrier: bool = False,
 ):
+    """Core take flow. Exactly TWO all-gathers in the default
+    multi-process path (the reference issues ~6 collectives,
+    snapshot.py:752-853; the round-2 port issued 6 serial-KV gathers):
+
+    - G1 (pre-staging): path + replicated globs + per-rank write-load
+      estimates + hostnames ride one gather. Glob/path coalescing, the
+      replicated-path intersection, the write-load partition plan (each
+      rank runs the same deterministic argmin-greedy — no broadcast),
+      and the local-world-size memory-budget divisor are all derived
+      from it locally.
+    - G2 (post-staging): the per-rank manifest gather, after stagers
+      have recorded checksums into their entries.
+
+    Plus the two commit barriers in ``take``. ``per_key_barrier=True``
+    adds the reference's key gather + barrier-per-key for statefuls
+    that run collectives inside ``state_dict()``.
+    """
     _validate_app_state(app_state)
     rank = comm.rank
+    multi = comm.world_size > 1
 
     # Capture RNG state on entry; other statefuls' state_dict() calls may
     # consume RNG, and take() must be invariant (reference :332-374).
@@ -372,13 +361,17 @@ def _take_impl(
         k: v.state_dict() for k, v in app_state.items() if isinstance(v, RNGState)
     }
 
-    global_keys = _gather_keys(comm, sorted(app_state.keys()))
+    if per_key_barrier and multi:
+        # Safety mode: globally ordered state_dict() calls with a barrier
+        # between keys (reference :352-368).
+        keys = _gather_keys(comm, sorted(app_state.keys()))
+    else:
+        keys = sorted(app_state.keys())
+
     manifest: Manifest = {}
     flattened_all: Dict[str, Any] = {}
-    for key in global_keys:
-        if comm.world_size > 1:
-            # state_dict() may itself run collectives; the barrier keeps
-            # different keys' collectives from interleaving (reference :362-368).
+    for key in keys:
+        if per_key_barrier and multi:
             comm.barrier()
         stateful = app_state.get(key)
         if stateful is None:
@@ -392,17 +385,83 @@ def _take_impl(
     for key, captured in rng_captured.items():
         app_state[key].load_state_dict(captured)
 
-    replicated_paths = _calculate_replicated_paths(
-        list(flattened_all.keys()), replicated, comm
+    # Local replicated candidates: glob-matched host-side values. A
+    # fully-replicated multi-process jax.Array needs no glob — it routes
+    # to the sharded preparer, whose replica-0 dedup stores one copy.
+    globs = sorted(set(replicated))
+    matched = {
+        p
+        for p in flattened_all
+        if any(fnmatch.fnmatch(p, g) for g in globs)
+    }
+
+    assignment: Dict[str, int] = {}
+    local_world_size: Optional[int] = None
+    if multi:
+        import socket
+
+        from .partitioner import assign_replicated_units, estimate_write_loads
+
+        units, base_load = estimate_write_loads(flattened_all, sorted(matched))
+        gathered = comm.all_gather_object(
+            {
+                "path": path,
+                "globs": globs,
+                "units": units,
+                "base_load": base_load,
+                "hostname": socket.gethostname(),
+            }
+        )
+        # Path coalescing: rank 0's wins (reference :766-767).
+        if gathered[0]["path"] != path:
+            logger.warning(
+                "Rank %d's snapshot path %r differs from rank 0's %r; "
+                "using rank 0's",
+                rank,
+                path,
+                gathered[0]["path"],
+            )
+        path = gathered[0]["path"]
+        # Glob coalescing: only globs specified on every rank count
+        # (reference :778-788).
+        common_globs = set(gathered[0]["globs"])
+        for g in gathered[1:]:
+            common_globs &= set(g["globs"])
+        dropped = set(globs) - common_globs
+        if dropped:
+            logger.warning(
+                "Replicated globs %s were not specified on every rank; "
+                "ignoring",
+                sorted(dropped),
+            )
+
+        # A unit is partitionable when every rank listed it AND its path
+        # matches a glob every rank specified.
+        def unit_valid(uid: str) -> bool:
+            p = uid.split("::", 1)[0]
+            return any(fnmatch.fnmatch(p, g) for g in common_globs)
+
+        assignment, replicated_paths = assign_replicated_units(
+            [g["units"] for g in gathered],
+            [g["base_load"] for g in gathered],
+            unit_valid,
+        )
+        my_host = gathered[rank]["hostname"]
+        local_world_size = sum(
+            1 for g in gathered if g["hostname"] == my_host
+        )
+    else:
+        replicated_paths = matched
+
+    storage = url_to_storage_plugin_in_event_loop(
+        path, event_loop, storage_options
     )
 
     entries: Manifest = dict(manifest)
     write_reqs = []
     replicated_entry_paths: List[str] = []
     for logical_path, leaf in flattened_all.items():
-        is_repl = logical_path in replicated_paths or _infer_replicated_leaf(
-            leaf, comm.world_size
-        )
+        is_repl = logical_path in replicated_paths
         entry, reqs = prepare_write(
             obj=leaf,
             logical_path=logical_path,
@@ -415,12 +474,15 @@ def _take_impl(
             replicated_entry_paths.append(logical_path)
         write_reqs.extend(reqs)
 
-    # Replicated write-load partitioning across ranks.
-    from .partitioner import partition_write_reqs
+    # Keep only the replicated write requests the plan assigned to this
+    # rank (plan computed identically on every rank from G1 — the
+    # reference's rank-0-compute + broadcast is one more collective).
+    if multi and replicated_entry_paths:
+        from .partitioner import filter_assigned_write_reqs
 
-    write_reqs = partition_write_reqs(
-        entries, write_reqs, replicated_entry_paths, comm
-    )
+        write_reqs = filter_assigned_write_reqs(
+            entries, write_reqs, replicated_entry_paths, assignment, rank
+        )
 
     # Slab-batch small writes.
     from .batcher import batch_write_requests
@@ -429,7 +491,9 @@ def _take_impl(
     entries_list, write_reqs = batch_write_requests(entries_list, write_reqs)
     entries = dict(zip(entries.keys(), entries_list))
 
-    memory_budget = get_process_memory_budget_bytes(comm)
+    memory_budget = get_process_memory_budget_bytes(
+        comm, local_world_size=local_world_size
+    )
     pending_io_work = sync_execute_write_reqs(
         write_reqs, storage, memory_budget, rank, event_loop
     )
@@ -443,7 +507,7 @@ def _take_impl(
     metadata = SnapshotMetadata(
         version=__version__, world_size=comm.world_size, manifest=global_manifest
     )
-    return pending_io_work, metadata
+    return pending_io_work, metadata, path, storage
 
 
 def _gather_manifest(entries: Manifest, comm: Communicator) -> Manifest:
