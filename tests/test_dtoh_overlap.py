@@ -1,0 +1,90 @@
+"""Device→host transfer overlap: the design claim behind prepare-time
+``copy_to_host_async`` enqueue (io_preparers/array.py enqueue_dtoh) is
+that N arrays' DMAs overlap, so staging wall-clock approaches the max,
+not the sum, of the transfers — the role the reference's thread-pooled
+GIL-released ``Tensor.to("cpu")`` plays (io_preparers/tensor.py:247-254).
+
+This must be measured against a REAL accelerator (the CPU backend's
+"transfer" is a memcpy with nothing to overlap), so the probe runs in a
+subprocess that does NOT inherit the suite's forced-CPU platform; it
+skips when no non-CPU device is reachable.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_PROBE = r"""
+import json, time
+import jax, jax.numpy as jnp
+import numpy as np
+
+dev = jax.devices()[0]
+if dev.platform == "cpu":
+    print(json.dumps({"skip": "no accelerator"}))
+    raise SystemExit(0)
+
+N = 4
+NB = 2 * 1024 * 1024 // 4  # 2 MB of f32 per array (tunnel-friendly)
+
+def fresh(tag):
+    arrs = [
+        jax.device_put(jnp.arange(NB, dtype=jnp.float32) + tag * 1000 + i, dev)
+        for i in range(N)
+    ]
+    jax.block_until_ready(arrs)
+    return arrs
+
+np.asarray(fresh(9)[0])  # warm up the transfer path
+
+best_ratio = None
+for attempt in range(3):
+    arrs = fresh(attempt * 2)
+    t0 = time.perf_counter()
+    for a in arrs:
+        np.asarray(a)  # serial: each transfer starts when requested
+    t_seq = time.perf_counter() - t0
+
+    arrs = fresh(attempt * 2 + 1)
+    t0 = time.perf_counter()
+    for a in arrs:
+        a.copy_to_host_async()  # all DMAs in flight before any wait
+    for a in arrs:
+        np.asarray(a)
+    t_overlap = time.perf_counter() - t0
+    ratio = t_overlap / t_seq
+    best_ratio = ratio if best_ratio is None else min(best_ratio, ratio)
+
+print(json.dumps({"ratio": best_ratio}))
+"""
+
+
+def test_copy_to_host_async_overlaps_transfers():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the real backend register
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE],
+        capture_output=True,
+        text=True,
+        timeout=280,
+        env=env,
+        cwd="/root/repo",
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"accelerator probe failed: {proc.stderr[-500:]}")
+    line = proc.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    # Pre-enqueued DMAs must beat serial request-then-wait transfers.
+    # (Measured ~0.79 on a tunneled v5e chip; real HBM DMA overlaps far
+    # more. 0.97 catches the regression mode: enqueue being a no-op that
+    # serializes everything behind dispatch.)
+    assert result["ratio"] < 0.97, (
+        f"copy_to_host_async enqueue shows no overlap: "
+        f"ratio={result['ratio']:.2f} (overlapped/serial)"
+    )

@@ -258,3 +258,65 @@ class TestDeviceBatching:
         for key, want in state.items():
             got = restored["m"].tree[key]
             assert np.asarray(got).tobytes() == np.asarray(want).tobytes()
+
+
+def test_estimate_matches_prepared_entries():
+    """Drift guard: estimate_write_loads' unit ids and costs must agree
+    with what prepare_write actually produces — the partition plan is
+    computed from the estimates, then applied to the prepared entries,
+    and any disagreement degrades into duplicate writes."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from tpusnap.io_preparer import prepare_write
+    from tpusnap.knobs import override_max_chunk_size_bytes
+    from tpusnap.manifest import ChunkedTensorEntry, PrimitiveEntry, TensorEntry
+    from tpusnap.partitioner import estimate_write_loads
+
+    def cast(path, arr, tracing):
+        return arr.astype(jnp.bfloat16) if path.endswith("big") else arr
+
+    with override_max_chunk_size_bytes(16 * 1024):
+        flattened = {
+            "m/big": np.zeros((64, 256), np.float32),      # chunked, casts
+            "m/small": np.arange(100, dtype=np.float32),   # dense
+            "m/scalar": np.float32(3.5),                   # np.generic
+            "m/lr": 0.1,                                   # primitive
+            "m/blob": {1, 2, 3},                           # pickled object
+        }
+        units, base = estimate_write_loads(
+            flattened, sorted(flattened), array_prepare_func=cast
+        )
+        unit_ids = {u for u, _ in units}
+        unit_costs = dict(units)
+
+        for path, leaf in flattened.items():
+            entry, _ = prepare_write(
+                obj=leaf,
+                logical_path=path,
+                rank=0,
+                replicated=True,
+                array_prepare_func=functools.partial(cast, path),
+            )
+            if isinstance(entry, PrimitiveEntry):
+                assert (path, 0) in units
+            elif isinstance(entry, ChunkedTensorEntry):
+                for i, chunk in enumerate(entry.chunks):
+                    uid = f"{path}::{i}"
+                    assert uid in unit_ids, (uid, sorted(unit_ids))
+                    from tpusnap.serialization import tensor_nbytes
+
+                    assert unit_costs[uid] == tensor_nbytes(
+                        chunk.tensor.dtype, chunk.tensor.shape
+                    )
+                assert f"{path}::{len(entry.chunks)}" not in unit_ids
+            elif isinstance(entry, TensorEntry):
+                assert path in unit_ids
+                from tpusnap.serialization import tensor_nbytes
+
+                assert unit_costs[path] == tensor_nbytes(
+                    entry.dtype, entry.shape
+                )
+            else:  # ObjectEntry: getsizeof approximation, just present
+                assert path in unit_ids

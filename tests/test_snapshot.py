@@ -189,3 +189,70 @@ def test_metadata_file_is_last(tmp_path):
     snap_path = tmp_path / "snap"
     Snapshot.take(str(snap_path), {"s": StateDict(x=jnp.ones(4))})
     assert (snap_path / ".snapshot_metadata").exists()
+
+
+class TestCustomArrayPrepareFunc:
+    """Save-time array transform (reference _custom_tensor_prepare_func,
+    snapshot.py:170-196): cast/quantize on save, restore honors the
+    stored dtype."""
+
+    def test_f32_to_bf16_on_save(self, tmp_path):
+        import jax.numpy as jnp
+
+        def cast_weights(path, arr, tracing):
+            if path.endswith("/w"):
+                return arr.astype(jnp.bfloat16)
+            return arr
+
+        w = np.linspace(-3, 3, 4096, dtype=np.float32)
+        b = np.arange(16, dtype=np.float32)
+        Snapshot.take(
+            str(tmp_path / "s"),
+            {"m": StateDict(w=w.copy(), b=b.copy())},
+            _custom_array_prepare_func=cast_weights,
+        )
+        manifest = Snapshot(str(tmp_path / "s")).get_manifest()
+        assert manifest["0/m/w"].dtype == "bfloat16"
+        assert manifest["0/m/b"].dtype == "float32"
+
+        # Restore honors the stored dtype: the value comes back bf16
+        # (precision loss is the user's explicit choice).
+        target = {"m": StateDict(w=np.zeros_like(w), b=np.zeros_like(b))}
+        Snapshot(str(tmp_path / "s")).restore(target)
+        restored_w = target["m"]["w"]
+        assert str(np.asarray(restored_w).dtype) == "bfloat16"
+        np.testing.assert_allclose(
+            np.asarray(restored_w, dtype=np.float32), w, atol=0.02
+        )
+        np.testing.assert_array_equal(target["m"]["b"], b)
+
+    def test_chunked_transform(self, tmp_path):
+        import jax.numpy as jnp
+
+        from tpusnap.knobs import override_max_chunk_size_bytes
+
+        arr = np.random.default_rng(0).standard_normal((64, 256)).astype(np.float32)
+        with override_max_chunk_size_bytes(16 * 1024):
+            Snapshot.take(
+                str(tmp_path / "s"),
+                {"m": StateDict(w=arr.copy())},
+                _custom_array_prepare_func=lambda p, a, tracing: a.astype(
+                    jnp.bfloat16
+                ),
+            )
+        entry = Snapshot(str(tmp_path / "s")).get_manifest()["0/m/w"]
+        assert entry.type == "ChunkedTensor" and entry.dtype == "bfloat16"
+        assert len(entry.chunks) > 1
+        out = Snapshot(str(tmp_path / "s")).read_object("0/m/w")
+        assert str(out.dtype) == "bfloat16"
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32), arr, atol=0.05
+        )
+
+    def test_shape_change_rejected(self, tmp_path):
+        with pytest.raises(RuntimeError, match="shape"):
+            Snapshot.take(
+                str(tmp_path / "s"),
+                {"m": StateDict(w=np.arange(100, dtype=np.float32))},
+                _custom_array_prepare_func=lambda p, a, tracing: a[:50],
+            )

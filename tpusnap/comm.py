@@ -69,6 +69,15 @@ class Communicator:
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:
         return obj
 
+    def gc_consumed_keys(self) -> None:
+        """Release KV keys of fully-consumed collectives. Callers must
+        hold external proof that EVERY rank consumed them (e.g. all
+        ranks departed a LinearBarrier issued after the collective) —
+        async_take's background commit uses this, since it never issues
+        another barrier on the communicator. Pure KV deletes: safe from
+        any thread."""
+        return None
+
 
 _instance_count = 0
 
@@ -111,8 +120,13 @@ class JaxCoordinationComm(Communicator):
         # namespaces agree across ranks. Ranks must issue their FIRST
         # collective on instances in the same order (SPMD); pass
         # ``namespace`` explicitly when that order may diverge.
+        # Explicit namespaces live under "u/" with unsafe characters
+        # escaped, so they can never collide with an auto namespace
+        # ("i<N>") nor map onto another namespace's barrier ids.
         self._ns: Optional[str] = (
-            f"tpusnap/{namespace}" if namespace is not None else None
+            f"tpusnap/u/{_sanitize_ns(namespace)}"
+            if namespace is not None
+            else None
         )
         self._seq = 0
         # Prefixes fully consumed on this rank, deletable (by rank 0)
@@ -151,10 +165,16 @@ class JaxCoordinationComm(Communicator):
 
     def barrier(self) -> None:
         seq = self._next_seq()
+        # Namespace components contain no "." (auto ids are digits,
+        # explicit ones are sanitized), so this mapping is injective —
+        # distinct namespaces can never satisfy each other's barriers.
         self._client.wait_at_barrier(
-            f"{self._namespace()}_b{seq}".replace("/", "_"),
+            f"{self._namespace()}/b{seq}".replace("/", "."),
             timeout_in_ms=self._timeout_ms,
         )
+        self._flush_gc()
+
+    def gc_consumed_keys(self) -> None:
         self._flush_gc()
 
     def all_gather_object(self, obj: Any) -> List[Any]:
@@ -194,6 +214,16 @@ class JaxCoordinationComm(Communicator):
         if self._rank == 0:
             self._gc_pending.append(key)
         return result
+
+
+def _sanitize_ns(ns: str) -> str:
+    """Escape everything outside [A-Za-z0-9_-]: keeps user namespaces
+    from colliding with each other or with key/barrier separators."""
+    import re
+
+    return re.sub(
+        r"[^A-Za-z0-9_-]", lambda m: f"%{ord(m.group(0)):02x}", ns
+    )
 
 
 def _encode(obj: Any) -> str:

@@ -52,7 +52,12 @@ def prepare_write(
     rank: int,
     replicated: bool = False,
     is_async_snapshot: bool = False,
+    array_prepare_func: Optional[Any] = None,
 ) -> Tuple[Entry, List[WriteReq]]:
+    """``array_prepare_func(arr, tracing) -> arr`` is the user save-time
+    transform (reference _custom_tensor_prepare_func, snapshot.py:
+    170-196); it applies to dense and chunked arrays — sharded arrays
+    and non-array objects pass through untransformed."""
     if PrimitiveEntry.supported(obj):
         return PrimitiveEntry.from_object(obj, replicated=replicated), []
 
@@ -69,10 +74,18 @@ def prepare_write(
         storage_path = get_storage_path(logical_path, rank, replicated, sharded=False)
         if should_chunk(obj):
             return ChunkedArrayIOPreparer.prepare_write(
-                storage_path, obj, replicated, is_async_snapshot
+                storage_path,
+                obj,
+                replicated,
+                is_async_snapshot,
+                array_prepare_func=array_prepare_func,
             )
         return ArrayIOPreparer.prepare_write(
-            storage_path, obj, replicated, is_async_snapshot
+            storage_path,
+            obj,
+            replicated,
+            is_async_snapshot,
+            array_prepare_func=array_prepare_func,
         )
 
     storage_path = get_storage_path(logical_path, rank, replicated, sharded=False)

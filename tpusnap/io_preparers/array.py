@@ -23,7 +23,7 @@ from __future__ import annotations
 import asyncio
 import math
 from concurrent.futures import Executor
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -82,6 +82,7 @@ class ArrayBufferStager(BufferStager):
         arr: ArrayLike,
         is_async_snapshot: bool = False,
         entry: Optional[TensorEntry] = None,
+        array_prepare_func: Optional[Callable[[ArrayLike, bool], ArrayLike]] = None,
     ) -> None:
         self.arr = arr
         self.is_async_snapshot = is_async_snapshot
@@ -89,7 +90,14 @@ class ArrayBufferStager(BufferStager):
         # manifest is gathered after staging completes, so the value lands
         # in the committed metadata.
         self.entry = entry
-        enqueue_dtoh(arr)
+        # User save-time transform (dtype cast / quantize-on-save),
+        # applied to the ORIGINAL array at stage time with tracing=False
+        # (reference io_preparers/tensor.py:231-241).
+        self.array_prepare_func = array_prepare_func
+        if array_prepare_func is None:
+            # A transform usually changes the bytes; prefetching the
+            # untransformed array's DtoH would be wasted DMA.
+            enqueue_dtoh(arr)
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         loop = asyncio.get_running_loop()
@@ -100,7 +108,21 @@ class ArrayBufferStager(BufferStager):
     def _stage_blocking(self) -> BufferType:
         from ..knobs import is_checksum_disabled
 
-        host = np.asarray(self.arr)  # DtoH (no-op if DMA already done)
+        arr = self.arr
+        if self.array_prepare_func is not None:
+            arr = self.array_prepare_func(arr, False)  # tracing=False
+            if self.entry is not None and (
+                list(arr.shape) != list(self.entry.shape)
+                or dtype_to_string(arr.dtype) != self.entry.dtype
+            ):
+                raise RuntimeError(
+                    "_custom_array_prepare_func returned "
+                    f"{arr.dtype}{list(arr.shape)} at stage time but "
+                    f"{self.entry.dtype}{list(self.entry.shape)} was "
+                    "recorded at prepare time — the transform must be "
+                    "deterministic"
+                )
+        host = np.asarray(arr)  # DtoH (no-op if DMA already done)
         mv = array_as_memoryview(host)
         if self.entry is not None and not is_checksum_disabled():
             _record_checksums(self.entry, mv)
@@ -116,7 +138,11 @@ class ArrayBufferStager(BufferStager):
         return mv
 
     def get_staging_cost_bytes(self) -> int:
-        n = array_nbytes(self.arr)
+        if self.array_prepare_func is not None and self.entry is not None:
+            # What will actually be staged is the transformed array.
+            n = tensor_nbytes(self.entry.dtype, self.entry.shape)
+        else:
+            n = array_nbytes(self.arr)
         # async snapshots hold a second host copy while in flight
         return 2 * n if self.is_async_snapshot else n
 
@@ -334,6 +360,36 @@ def materialize_array(
     return src.copy()
 
 
+def trace_array_prepare(
+    arr: ArrayLike,
+    array_prepare_func: Optional[Callable[[ArrayLike, bool], ArrayLike]],
+) -> Tuple[str, List[int]]:
+    """The (dtype, shape) the manifest must record for ``arr`` under an
+    optional save-time transform — discovered WITHOUT computing the
+    transform when possible: jax transforms are traced via
+    ``jax.eval_shape`` (abstract evaluation, zero FLOPs — the TPU-first
+    analog of the reference's tracing=True call on a real tensor,
+    io_preparers/tensor.py:57-66); non-traceable transforms fall back to
+    one real call whose result is discarded. Shape changes are rejected
+    like the reference's."""
+    if array_prepare_func is None:
+        return dtype_to_string(arr.dtype), list(arr.shape)
+    import functools
+
+    try:
+        traced = jax.eval_shape(
+            functools.partial(array_prepare_func, tracing=True), arr
+        )
+    except Exception:
+        traced = array_prepare_func(arr, True)  # tracing=True
+    if list(traced.shape) != list(arr.shape):
+        raise RuntimeError(
+            "_custom_array_prepare_func must not change the array's "
+            f"shape (changed from {list(arr.shape)} to {list(traced.shape)})"
+        )
+    return dtype_to_string(traced.dtype), list(traced.shape)
+
+
 class ArrayIOPreparer:
     """prepare_write/prepare_read for dense (single-blob) arrays
     (reference TensorIOPreparer, io_preparers/tensor.py:47-222)."""
@@ -344,18 +400,25 @@ class ArrayIOPreparer:
         arr: ArrayLike,
         replicated: bool = False,
         is_async_snapshot: bool = False,
+        array_prepare_func: Optional[Callable[[ArrayLike, bool], ArrayLike]] = None,
     ) -> Tuple[TensorEntry, List[WriteReq]]:
+        dtype, shape = trace_array_prepare(arr, array_prepare_func)
         entry = TensorEntry(
             location=storage_path,
             serializer=Serializer.BUFFER_PROTOCOL.value,
-            dtype=dtype_to_string(arr.dtype),
-            shape=list(arr.shape),
+            dtype=dtype,
+            shape=shape,
             replicated=replicated,
         )
         write_reqs = [
             WriteReq(
                 path=storage_path,
-                buffer_stager=ArrayBufferStager(arr, is_async_snapshot, entry=entry),
+                buffer_stager=ArrayBufferStager(
+                    arr,
+                    is_async_snapshot,
+                    entry=entry,
+                    array_prepare_func=array_prepare_func,
+                ),
             )
         ]
         return entry, write_reqs

@@ -54,9 +54,14 @@ class ChunkedArrayIOPreparer:
         arr,
         replicated: bool = False,
         is_async_snapshot: bool = False,
+        array_prepare_func=None,
     ) -> Tuple[ChunkedTensorEntry, List[WriteReq]]:
-        dtype = dtype_to_string(arr.dtype)
-        shape = list(arr.shape)
+        from .array import trace_array_prepare
+
+        # Chunk geometry follows the TRANSFORMED dtype (a cast-on-save
+        # changes bytes-per-row); the transform itself is applied
+        # per-chunk at stage time (reference chunked_tensor.py:82-94).
+        dtype, shape = trace_array_prepare(arr, array_prepare_func)
         ranges = chunk_row_ranges(shape, dtype, get_max_chunk_size_bytes())
         chunks: List[Chunk] = []
         write_reqs: List[WriteReq] = []
@@ -83,7 +88,10 @@ class ChunkedArrayIOPreparer:
                 WriteReq(
                     path=location,
                     buffer_stager=ArrayBufferStager(
-                        sub, is_async_snapshot, entry=tensor_entry
+                        sub,
+                        is_async_snapshot,
+                        entry=tensor_entry,
+                        array_prepare_func=array_prepare_func,
                     ),
                 )
             )

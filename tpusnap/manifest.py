@@ -285,23 +285,32 @@ class OrderedDictEntry(Entry):
 class PrimitiveEntry(Entry):
     """int/float/bool/str/bytes inlined directly into the metadata.
 
-    Floats are stored bit-exactly: base64 of the IEEE-754 double, matching
-    the reference's readable=False float path (manifest.py:221-245).
+    Floats are stored bit-exactly (base64 of the IEEE-754 double) WITH a
+    human-readable companion value so manifests stay auditable — the
+    reference stores both for the same reason (manifest.py:221-245).
+    Restore always uses the bit-exact form.
     """
 
     dtype: str
     layout: str
     serialized_value: str
     replicated: bool
+    readable: Optional[str] = None
 
     def __init__(
-        self, dtype: str, layout: str, serialized_value: str, replicated: bool
+        self,
+        dtype: str,
+        layout: str,
+        serialized_value: str,
+        replicated: bool,
+        readable: Optional[str] = None,
     ) -> None:
         super().__init__(type="primitive")
         self.dtype = dtype
         self.layout = layout
         self.serialized_value = serialized_value
         self.replicated = replicated
+        self.readable = readable
 
     SUPPORTED_TYPES = (int, float, bool, str, bytes)
 
@@ -321,7 +330,7 @@ class PrimitiveEntry(Entry):
             return cls("str", "text", obj, replicated)
         if t is float:
             packed = base64.b64encode(struct.pack("<d", obj)).decode("ascii")
-            return cls("float", "b64_le_f64", packed, replicated)
+            return cls("float", "b64_le_f64", packed, replicated, readable=repr(obj))
         if t is bytes:
             return cls("bytes", "b64", base64.b64encode(obj).decode("ascii"), replicated)
         raise TypeError(f"Unsupported primitive type: {t}")
@@ -348,6 +357,7 @@ class PrimitiveEntry(Entry):
             layout=d["layout"],
             serialized_value=d["serialized_value"],
             replicated=d["replicated"],
+            readable=d.get("readable"),
         )
 
 

@@ -31,7 +31,9 @@ logger = logging.getLogger(__name__)
 
 
 def estimate_write_loads(
-    flattened: Dict[str, object], replicated_candidates: List[str]
+    flattened: Dict[str, object],
+    replicated_candidates: List[str],
+    array_prepare_func=None,
 ) -> Tuple[List[Tuple[str, int]], int]:
     """Pre-prepare, collective-free load estimation for this rank.
 
@@ -39,19 +41,28 @@ def estimate_write_loads(
     per replicated candidate (chunked arrays subpartition per chunk,
     unit id ``"path::<chunk_idx>"``), and the rank's non-replicated
     write bytes. Costs mirror what the preparers will produce — array
-    nbytes, chunk-grain splits, sys.getsizeof for pickled objects (the
-    reference's own approximation, object.py:76-78) — so every rank can
-    run the same deterministic assignment on the gathered results with
-    NO extra collective and NO broadcast."""
+    nbytes under the (traced) save-time transform, chunk-grain splits,
+    sys.getsizeof for pickled objects (the reference's own
+    approximation, object.py:76-78) — so every rank can run the same
+    deterministic assignment on the gathered results with NO extra
+    collective and NO broadcast. The routing predicates ARE the
+    preparers' own (is_sharded / should_chunk / chunk_row_ranges /
+    trace_array_prepare); tests/test_partitioner_batcher.py pins unit
+    ids against actually-prepared entries to catch drift.
+
+    ``array_prepare_func(logical_path, arr, tracing)`` must be the same
+    transform later given to prepare_write."""
+    import functools
     import sys as _sys
 
     import jax
     import numpy as np
 
+    from .io_preparers.array import trace_array_prepare
     from .io_preparers.chunked import chunk_row_ranges, should_chunk
     from .io_preparers.sharded import is_sharded
     from .manifest import PrimitiveEntry
-    from .serialization import dtype_to_string, tensor_nbytes
+    from .serialization import tensor_nbytes
 
     candidates = set(replicated_candidates)
     units: List[Tuple[str, int]] = []
@@ -65,6 +76,8 @@ def estimate_write_loads(
             if path in candidates:
                 units.append((path, 0))
             continue
+        if isinstance(leaf, np.generic):  # mirrors prepare_write
+            leaf = np.asarray(leaf)
         is_array = isinstance(leaf, (jax.Array, np.ndarray))
         if is_array and isinstance(leaf, jax.Array) and is_sharded(leaf):
             # Sharded entries are never replicated-partitioned; their
@@ -78,9 +91,16 @@ def estimate_write_loads(
             continue
         if is_array:
             try:
-                dtype = dtype_to_string(leaf.dtype)
-                nbytes = tensor_nbytes(dtype, list(leaf.shape))
-            except ValueError:
+                # The stored dtype/shape under the save-time transform —
+                # the same trace the preparers will run.
+                dtype, shape = trace_array_prepare(
+                    leaf,
+                    functools.partial(array_prepare_func, path)
+                    if array_prepare_func is not None
+                    else None,
+                )
+                nbytes = tensor_nbytes(dtype, shape)
+            except (ValueError, RuntimeError):
                 nbytes = _sys.getsizeof(leaf)
                 dtype = None
         else:
@@ -91,10 +111,10 @@ def estimate_write_loads(
             continue
         if is_array and dtype is not None and should_chunk(leaf):
             for i, (r0, r1) in enumerate(
-                chunk_row_ranges(list(leaf.shape), dtype, _max_chunk())
+                chunk_row_ranges(list(shape), dtype, _max_chunk())
             ):
                 units.append(
-                    (f"{path}::{i}", tensor_nbytes(dtype, [r1 - r0] + list(leaf.shape[1:])))
+                    (f"{path}::{i}", tensor_nbytes(dtype, [r1 - r0] + list(shape[1:])))
                 )
         else:
             units.append((path, nbytes))

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import asyncio
 import fnmatch
+import functools
 import logging
 import threading
 import uuid
@@ -93,8 +94,17 @@ class Snapshot:
         storage_options: Optional[Dict[str, Any]] = None,
         comm: Optional[Communicator] = None,
         per_key_barrier: bool = False,
+        _custom_array_prepare_func: Optional[Any] = None,
     ) -> "Snapshot":
-        """``per_key_barrier=True`` restores the reference's barrier
+        """``_custom_array_prepare_func(logical_path, arr, tracing)``
+        transforms dense/chunked arrays at save time (dtype cast /
+        quantize-on-save; reference _custom_tensor_prepare_func,
+        snapshot.py:170-196). At prepare time it is traced abstractly
+        (``jax.eval_shape`` — zero FLOPs) to learn the stored
+        dtype/shape; at stage time it runs for real. It must not change
+        the shape, and must be deterministic.
+
+        ``per_key_barrier=True`` restores the reference's barrier
         between every stateful's ``state_dict()`` call (snapshot.py:
         362-368) — needed only when a stateful runs its own collectives
         inside ``state_dict`` and those must not interleave across keys.
@@ -112,6 +122,7 @@ class Snapshot:
                 event_loop=event_loop,
                 is_async_snapshot=False,
                 per_key_barrier=per_key_barrier,
+                array_prepare_func=_custom_array_prepare_func,
             )
             pending_io_work.sync_complete(event_loop)
             comm.barrier()
@@ -134,6 +145,7 @@ class Snapshot:
         storage_options: Optional[Dict[str, Any]] = None,
         comm: Optional[Communicator] = None,
         per_key_barrier: bool = False,
+        _custom_array_prepare_func: Optional[Any] = None,
     ) -> "PendingSnapshot":
         comm = get_communicator(comm)
         event_loop = asyncio.new_event_loop()
@@ -146,6 +158,7 @@ class Snapshot:
             event_loop=event_loop,
             is_async_snapshot=True,
             per_key_barrier=per_key_barrier,
+            array_prepare_func=_custom_array_prepare_func,
         )
         # Control returns to training here: staging is complete, the
         # snapshot content is frozen; only storage I/O remains.
@@ -333,6 +346,7 @@ def _take_impl(
     event_loop: asyncio.AbstractEventLoop,
     is_async_snapshot: bool,
     per_key_barrier: bool = False,
+    array_prepare_func: Optional[Any] = None,
 ):
     """Core take flow. Exactly TWO all-gathers in the default
     multi-process path (the reference issues ~6 collectives,
@@ -402,7 +416,9 @@ def _take_impl(
 
         from .partitioner import assign_replicated_units, estimate_write_loads
 
-        units, base_load = estimate_write_loads(flattened_all, sorted(matched))
+        units, base_load = estimate_write_loads(
+            flattened_all, sorted(matched), array_prepare_func=array_prepare_func
+        )
         gathered = comm.all_gather_object(
             {
                 "path": path,
@@ -468,6 +484,11 @@ def _take_impl(
             rank=rank,
             replicated=is_repl,
             is_async_snapshot=is_async_snapshot,
+            array_prepare_func=(
+                functools.partial(array_prepare_func, logical_path)
+                if array_prepare_func is not None
+                else None
+            ),
         )
         entries[logical_path] = entry
         if is_repl and is_replicated(entry):
@@ -647,6 +668,17 @@ class PendingSnapshot:
             if self._comm.rank == 0:
                 _write_metadata(self._storage, self._metadata, self._event_loop)
             self._barrier.depart()
+            # Every rank departing proves it consumed the take's gathers
+            # and the barrier-prefix broadcast; release their KV keys now
+            # — no further barrier will run on this communicator, so the
+            # lazy GC would otherwise never fire (and per-iteration
+            # manifests would accumulate in the coordination service
+            # forever). KV deletes only — still no collectives off the
+            # main thread.
+            try:
+                self._comm.gc_consumed_keys()
+            except Exception:
+                pass
             snapshot = Snapshot(self.path, self._storage_options, self._comm)
             snapshot._metadata = self._metadata
             self._snapshot = snapshot
