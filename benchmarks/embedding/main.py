@@ -57,6 +57,13 @@ def main() -> None:
     )
 
     with tempfile.TemporaryDirectory(prefix="tpusnap_bench_emb_") as work:
+        # Warm-up: the first take jit-compiles the device slab-pack
+        # program (one-time per slab composition); timing it against the
+        # warm async path below would misattribute compile time to the
+        # sync pipeline.
+        Snapshot.take(os.path.join(work, "warmup"), {"emb": PytreeState(params)})
+        os.sync()
+
         rss_deltas = []
         with measure_rss_deltas(rss_deltas):
             t0 = time.perf_counter()

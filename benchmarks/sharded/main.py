@@ -37,6 +37,13 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--d-model", type=int, default=1024)
     parser.add_argument("--n-layers", type=int, default=8)
+    parser.add_argument(
+        "--runs",
+        type=int,
+        default=3,
+        help="samples per phase; the virtio disk swings >2x minute to "
+        "minute, so best-of-N is the repeatable number",
+    )
     args = parser.parse_args()
 
     mesh = make_mesh()
@@ -52,18 +59,25 @@ def main() -> None:
     nbytes = sum(leaf.nbytes for leaf in jax.tree.leaves(state))
     print(f"train state: {nbytes / 1e9:.2f} GB over mesh {dict(mesh.shape)}")
 
+    take_runs, restore_runs = [], []
     with tempfile.TemporaryDirectory(prefix="tpusnap_bench_shard_") as work_dir:
-        path = os.path.join(work_dir, "snap")
-        t0 = time.perf_counter()
-        Snapshot.take(path, {"ts": PytreeState(state)})
-        take_s = time.perf_counter() - t0
-        print(f"take:    {take_s:.2f}s ({nbytes / take_s / 1e9:.2f} GB/s)")
+        for run in range(args.runs):
+            path = os.path.join(work_dir, f"snap{run}")
+            os.sync()
+            t0 = time.perf_counter()
+            Snapshot.take(path, {"ts": PytreeState(state)})
+            take_runs.append(time.perf_counter() - t0)
 
-        target = PytreeState(jax.tree.map(jnp.zeros_like, state))
-        t0 = time.perf_counter()
-        Snapshot(path).restore({"ts": target})
-        restore_s = time.perf_counter() - t0
-        print(f"restore: {restore_s:.2f}s ({nbytes / restore_s / 1e9:.2f} GB/s)")
+            target = PytreeState(jax.tree.map(jnp.zeros_like, state))
+            t0 = time.perf_counter()
+            Snapshot(path).restore({"ts": target})
+            restore_runs.append(time.perf_counter() - t0)
+
+    take_s, restore_s = min(take_runs), min(restore_runs)
+    print(f"take:    {take_s:.2f}s ({nbytes / take_s / 1e9:.2f} GB/s) "
+          f"runs={[round(t, 2) for t in take_runs]}")
+    print(f"restore: {restore_s:.2f}s ({nbytes / restore_s / 1e9:.2f} GB/s) "
+          f"runs={[round(t, 2) for t in restore_runs]}")
 
 
 if __name__ == "__main__":

@@ -72,7 +72,6 @@ def test_copy_to_host_async_overlaps_transfers():
         text=True,
         timeout=280,
         env=env,
-        cwd="/root/repo",
     )
     if proc.returncode != 0:
         pytest.skip(f"accelerator probe failed: {proc.stderr[-500:]}")

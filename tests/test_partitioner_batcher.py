@@ -285,9 +285,11 @@ def test_estimate_matches_prepared_entries():
             "m/lr": 0.1,                                   # primitive
             "m/blob": {1, 2, 3},                           # pickled object
         }
-        units, base = estimate_write_loads(
+        units, base, traced = estimate_write_loads(
             flattened, sorted(flattened), array_prepare_func=cast
         )
+        # The traced geometry covers every dense array leaf.
+        assert set(traced) == {"m/big", "m/small", "m/scalar"}
         unit_ids = {u for u, _ in units}
         unit_costs = dict(units)
 

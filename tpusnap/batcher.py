@@ -194,6 +194,11 @@ def _device_group_key(stager: BufferStager) -> Optional[str]:
     key; ``None`` → host packing."""
     if is_device_batching_disabled() or not isinstance(stager, ArrayBufferStager):
         return None
+    if stager.array_prepare_func is not None:
+        # The device pack bitcasts the ORIGINAL arrays; a save-time
+        # transform must run through the member stagers (host packing
+        # calls them; the device path would silently skip it).
+        return None
     import jax
     import numpy as np
 

@@ -41,6 +41,7 @@ from __future__ import annotations
 import base64
 import logging
 import pickle
+import threading
 from typing import Any, List, Optional
 
 logger = logging.getLogger(__name__)
@@ -69,13 +70,20 @@ class Communicator:
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:
         return obj
 
-    def gc_consumed_keys(self) -> None:
-        """Release KV keys of fully-consumed collectives. Callers must
-        hold external proof that EVERY rank consumed them (e.g. all
-        ranks departed a LinearBarrier issued after the collective) —
-        async_take's background commit uses this, since it never issues
-        another barrier on the communicator. Pure KV deletes: safe from
-        any thread."""
+    def gc_epoch(self) -> int:
+        """Marker for ``gc_consumed_keys``: keys pending GC as of now."""
+        return 0
+
+    def gc_consumed_keys(self, epoch: Optional[int] = None) -> None:
+        """Release KV keys of fully-consumed collectives — the first
+        ``epoch`` pending ones (from a prior ``gc_epoch()`` call), or
+        all when ``epoch`` is None. Callers must hold external proof
+        that EVERY rank consumed those keys (e.g. all ranks departed a
+        LinearBarrier issued after the collective) — async_take's
+        background commit uses this, since it never issues another
+        barrier on the communicator. The epoch bound keeps a background
+        flush from deleting keys of collectives the main thread started
+        AFTER the proof point. Pure KV deletes: safe from any thread."""
         return None
 
 
@@ -131,7 +139,10 @@ class JaxCoordinationComm(Communicator):
         self._seq = 0
         # Prefixes fully consumed on this rank, deletable (by rank 0)
         # once a later barrier proves every rank has moved past them.
+        # Guarded by a lock: the async-commit background thread flushes
+        # while the main thread may be appending for a newer take.
         self._gc_pending: List[str] = []
+        self._gc_lock = threading.Lock()
 
     @property
     def rank(self) -> int:
@@ -150,18 +161,24 @@ class JaxCoordinationComm(Communicator):
         self._seq += 1
         return self._seq
 
-    def _flush_gc(self) -> None:
-        """Delete prefixes whose consumption a barrier just proved global.
-        Called only immediately after a successful wait_at_barrier."""
+    def _flush_gc(self, upto: Optional[int] = None) -> None:
+        """Delete pending prefixes whose consumption has been proved
+        global — the first ``upto`` of them, or all when None. Called
+        right after a successful wait_at_barrier (all pending), or from
+        the async commit with an epoch captured at its proof point."""
+        with self._gc_lock:
+            if upto is None:
+                flush, self._gc_pending = self._gc_pending, []
+            else:
+                flush = self._gc_pending[:upto]
+                self._gc_pending = self._gc_pending[upto:]
         if self._rank != 0:
-            self._gc_pending.clear()
             return
-        for prefix in self._gc_pending:
+        for prefix in flush:
             try:
                 self._client.key_value_delete(prefix)
             except Exception:
                 pass
-        self._gc_pending.clear()
 
     def barrier(self) -> None:
         seq = self._next_seq()
@@ -174,8 +191,12 @@ class JaxCoordinationComm(Communicator):
         )
         self._flush_gc()
 
-    def gc_consumed_keys(self) -> None:
-        self._flush_gc()
+    def gc_epoch(self) -> int:
+        with self._gc_lock:
+            return len(self._gc_pending)
+
+    def gc_consumed_keys(self, epoch: Optional[int] = None) -> None:
+        self._flush_gc(upto=epoch)
 
     def all_gather_object(self, obj: Any) -> List[Any]:
         """One KV set + one barrier + ONE dir-get — O(1) RPCs per rank
@@ -196,7 +217,8 @@ class JaxCoordinationComm(Communicator):
                 f"all_gather {prefix!r}: expected {self._world_size} "
                 f"entries, got {sorted(by_rank)}"
             )
-        self._gc_pending.append(prefix + "/")
+        with self._gc_lock:
+            self._gc_pending.append(prefix + "/")
         return [_decode(by_rank[r]) for r in range(self._world_size)]
 
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:
@@ -212,7 +234,8 @@ class JaxCoordinationComm(Communicator):
                 self._client.blocking_key_value_get(key, self._timeout_ms)
             )
         if self._rank == 0:
-            self._gc_pending.append(key)
+            with self._gc_lock:
+                self._gc_pending.append(key)
         return result
 
 

@@ -53,11 +53,15 @@ def prepare_write(
     replicated: bool = False,
     is_async_snapshot: bool = False,
     array_prepare_func: Optional[Any] = None,
+    array_prepare_traced: Optional[Tuple[str, Any]] = None,
 ) -> Tuple[Entry, List[WriteReq]]:
     """``array_prepare_func(arr, tracing) -> arr`` is the user save-time
     transform (reference _custom_tensor_prepare_func, snapshot.py:
     170-196); it applies to dense and chunked arrays — sharded arrays
-    and non-array objects pass through untransformed."""
+    and non-array objects pass through untransformed.
+    ``array_prepare_traced`` is the already-traced (dtype, shape) from
+    the write-load estimator, so untraceable transforms don't execute a
+    second discarded time here."""
     if PrimitiveEntry.supported(obj):
         return PrimitiveEntry.from_object(obj, replicated=replicated), []
 
@@ -79,6 +83,7 @@ def prepare_write(
                 replicated,
                 is_async_snapshot,
                 array_prepare_func=array_prepare_func,
+                array_prepare_traced=array_prepare_traced,
             )
         return ArrayIOPreparer.prepare_write(
             storage_path,
@@ -86,6 +91,7 @@ def prepare_write(
             replicated,
             is_async_snapshot,
             array_prepare_func=array_prepare_func,
+            array_prepare_traced=array_prepare_traced,
         )
 
     storage_path = get_storage_path(logical_path, rank, replicated, sharded=False)

@@ -401,8 +401,12 @@ class ArrayIOPreparer:
         replicated: bool = False,
         is_async_snapshot: bool = False,
         array_prepare_func: Optional[Callable[[ArrayLike, bool], ArrayLike]] = None,
+        array_prepare_traced: Optional[Tuple[str, List[int]]] = None,
     ) -> Tuple[TensorEntry, List[WriteReq]]:
-        dtype, shape = trace_array_prepare(arr, array_prepare_func)
+        if array_prepare_traced is not None:
+            dtype, shape = array_prepare_traced[0], list(array_prepare_traced[1])
+        else:
+            dtype, shape = trace_array_prepare(arr, array_prepare_func)
         entry = TensorEntry(
             location=storage_path,
             serializer=Serializer.BUFFER_PROTOCOL.value,

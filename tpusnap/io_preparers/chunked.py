@@ -55,13 +55,17 @@ class ChunkedArrayIOPreparer:
         replicated: bool = False,
         is_async_snapshot: bool = False,
         array_prepare_func=None,
+        array_prepare_traced=None,
     ) -> Tuple[ChunkedTensorEntry, List[WriteReq]]:
         from .array import trace_array_prepare
 
         # Chunk geometry follows the TRANSFORMED dtype (a cast-on-save
         # changes bytes-per-row); the transform itself is applied
         # per-chunk at stage time (reference chunked_tensor.py:82-94).
-        dtype, shape = trace_array_prepare(arr, array_prepare_func)
+        if array_prepare_traced is not None:
+            dtype, shape = array_prepare_traced[0], list(array_prepare_traced[1])
+        else:
+            dtype, shape = trace_array_prepare(arr, array_prepare_func)
         ranges = chunk_row_ranges(shape, dtype, get_max_chunk_size_bytes())
         chunks: List[Chunk] = []
         write_reqs: List[WriteReq] = []

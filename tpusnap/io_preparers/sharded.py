@@ -193,6 +193,8 @@ class ShardedArrayIOPreparer:
                 if saved.tensor.byte_range is not None
                 else None
             )
+            from .array import _want_crc
+
             read_reqs.append(
                 ReadReq(
                     path=saved.tensor.location,
@@ -206,6 +208,10 @@ class ShardedArrayIOPreparer:
                             f"(shard @ {saved.offsets})"
                         ),
                     ),
+                    # Checksum computed inside the storage plugin's read
+                    # (fused on the read thread); the consumer verifies
+                    # the value without re-reading the buffer.
+                    want_crc=_want_crc(saved.tensor),
                 )
             )
         assembler.total_reads = len(read_reqs)
@@ -364,6 +370,22 @@ class _ScatterConsumer(BufferConsumer):
         self.overlaps = overlaps
         self.assembler = assembler
         self.verify_location = verify_location or saved.tensor.location
+        self._verified = False
+
+    async def consume_read_io(self, read_io, executor: Optional[Executor] = None) -> None:
+        if read_io.crc32c is not None and self.saved.tensor.checksum is not None:
+            # The storage plugin hashed the bytes during the read; verify
+            # the 4-byte value here and skip the re-hash pass below.
+            from .. import _native
+
+            _native.verify_checksum_value(
+                read_io.crc32c,
+                read_io.crc_algo,
+                self.saved.tensor.checksum,
+                self.verify_location,
+            )
+            self._verified = True
+        await self.consume_buffer(read_io.buf.getbuffer(), executor)
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -379,7 +401,8 @@ class _ScatterConsumer(BufferConsumer):
     def _scatter(self, buf: BufferType) -> None:
         from .array import _maybe_verify
 
-        _maybe_verify(buf, self.saved.tensor.checksum, self.verify_location)
+        if not self._verified:
+            _maybe_verify(buf, self.saved.tensor.checksum, self.verify_location)
         saved_arr = array_from_memoryview(
             memoryview(buf), self.saved.tensor.dtype, self.saved.sizes
         )

@@ -34,21 +34,25 @@ def estimate_write_loads(
     flattened: Dict[str, object],
     replicated_candidates: List[str],
     array_prepare_func=None,
-) -> Tuple[List[Tuple[str, int]], int]:
+) -> Tuple[List[Tuple[str, int]], int, Dict[str, Tuple[str, List[int]]]]:
     """Pre-prepare, collective-free load estimation for this rank.
 
-    Returns ``(replicated_units, base_load)``: one ``(unit_id, cost)``
-    per replicated candidate (chunked arrays subpartition per chunk,
-    unit id ``"path::<chunk_idx>"``), and the rank's non-replicated
-    write bytes. Costs mirror what the preparers will produce — array
-    nbytes under the (traced) save-time transform, chunk-grain splits,
-    sys.getsizeof for pickled objects (the reference's own
-    approximation, object.py:76-78) — so every rank can run the same
-    deterministic assignment on the gathered results with NO extra
-    collective and NO broadcast. The routing predicates ARE the
-    preparers' own (is_sharded / should_chunk / chunk_row_ranges /
-    trace_array_prepare); tests/test_partitioner_batcher.py pins unit
-    ids against actually-prepared entries to catch drift.
+    Returns ``(replicated_units, base_load, traced_map)``: one
+    ``(unit_id, cost)`` per replicated candidate (chunked arrays
+    subpartition per chunk, unit id ``"path::<chunk_idx>"``), the rank's
+    non-replicated write bytes, and the traced post-transform
+    ``{path: (dtype, shape)}`` geometry for every dense array — handed
+    back to prepare_write so untraceable transforms don't execute twice.
+    Costs mirror what the preparers will produce — array nbytes under
+    the (traced) save-time transform, chunk-grain splits, sys.getsizeof
+    for pickled objects (the reference's own approximation,
+    object.py:76-78) — so every rank can run the same deterministic
+    assignment on the gathered results with NO extra collective and NO
+    broadcast. The routing predicates ARE the preparers' own
+    (is_supported_array_dtype / is_sharded / should_chunk /
+    chunk_row_ranges / trace_array_prepare);
+    tests/test_partitioner_batcher.py pins unit ids against
+    actually-prepared entries to catch drift.
 
     ``array_prepare_func(logical_path, arr, tracing)`` must be the same
     transform later given to prepare_write."""
@@ -58,7 +62,7 @@ def estimate_write_loads(
     import jax
     import numpy as np
 
-    from .io_preparers.array import trace_array_prepare
+    from .io_preparers.array import is_supported_array_dtype, trace_array_prepare
     from .io_preparers.chunked import chunk_row_ranges, should_chunk
     from .io_preparers.sharded import is_sharded
     from .manifest import PrimitiveEntry
@@ -66,6 +70,7 @@ def estimate_write_loads(
 
     candidates = set(replicated_candidates)
     units: List[Tuple[str, int]] = []
+    traced_map: Dict[str, Tuple[str, List[int]]] = {}
     base_load = 0
     for path in sorted(flattened):
         leaf = flattened[path]
@@ -89,21 +94,23 @@ def estimate_write_loads(
             except Exception:
                 pass
             continue
-        if is_array:
-            try:
-                # The stored dtype/shape under the save-time transform —
-                # the same trace the preparers will run.
-                dtype, shape = trace_array_prepare(
-                    leaf,
-                    functools.partial(array_prepare_func, path)
-                    if array_prepare_func is not None
-                    else None,
-                )
-                nbytes = tensor_nbytes(dtype, shape)
-            except (ValueError, RuntimeError):
-                nbytes = _sys.getsizeof(leaf)
-                dtype = None
+        # Mirror prepare_write's routing: only supported-dtype arrays
+        # reach the array preparers (and hence the save-time transform);
+        # anything else is pickled untransformed.
+        if is_array and is_supported_array_dtype(leaf):
+            # The stored dtype/shape under the save-time transform — the
+            # same trace the preparers will run (cached into traced_map
+            # so prepare_write doesn't re-execute untraceable transforms).
+            dtype, shape = trace_array_prepare(
+                leaf,
+                functools.partial(array_prepare_func, path)
+                if array_prepare_func is not None
+                else None,
+            )
+            traced_map[path] = (dtype, shape)
+            nbytes = tensor_nbytes(dtype, shape)
         else:
+            is_array = False
             nbytes = _sys.getsizeof(leaf)
             dtype = None
         if path not in candidates:
@@ -118,7 +125,7 @@ def estimate_write_loads(
                 )
         else:
             units.append((path, nbytes))
-    return units, base_load
+    return units, base_load, traced_map
 
 
 def _max_chunk() -> int:

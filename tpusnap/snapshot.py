@@ -416,7 +416,7 @@ def _take_impl(
 
         from .partitioner import assign_replicated_units, estimate_write_loads
 
-        units, base_load = estimate_write_loads(
+        units, base_load, traced_map = estimate_write_loads(
             flattened_all, sorted(matched), array_prepare_func=array_prepare_func
         )
         gathered = comm.all_gather_object(
@@ -466,8 +466,10 @@ def _take_impl(
         local_world_size = sum(
             1 for g in gathered if g["hostname"] == my_host
         )
+        traced_geometry = traced_map
     else:
         replicated_paths = matched
+        traced_geometry = {}
 
     storage = url_to_storage_plugin_in_event_loop(
         path, event_loop, storage_options
@@ -489,6 +491,7 @@ def _take_impl(
                 if array_prepare_func is not None
                 else None
             ),
+            array_prepare_traced=traced_geometry.get(logical_path),
         )
         entries[logical_path] = entry
         if is_repl and is_replicated(entry):
@@ -649,6 +652,10 @@ class PendingSnapshot:
         # broadcast); the background thread then only touches the KV store.
         barrier_prefix = f"tpusnap_commit/{uuid.uuid4().hex}"
         barrier_prefix = comm.broadcast_object(barrier_prefix, src=0)
+        # GC proof point: the commit barrier will prove consumption of
+        # everything pending NOW; collectives the main thread issues
+        # later (a newer take on the same communicator) stay pending.
+        self._gc_epoch = comm.gc_epoch()
         self._barrier = LinearBarrier(
             store=_get_kv_store(comm),
             prefix=barrier_prefix,
@@ -673,10 +680,11 @@ class PendingSnapshot:
             # — no further barrier will run on this communicator, so the
             # lazy GC would otherwise never fire (and per-iteration
             # manifests would accumulate in the coordination service
-            # forever). KV deletes only — still no collectives off the
-            # main thread.
+            # forever). Bounded by the epoch captured at construction so
+            # a newer take's in-flight keys are never touched. KV deletes
+            # only — still no collectives off the main thread.
             try:
-                self._comm.gc_consumed_keys()
+                self._comm.gc_consumed_keys(self._gc_epoch)
             except Exception:
                 pass
             snapshot = Snapshot(self.path, self._storage_options, self._comm)

@@ -76,7 +76,7 @@ class FSStoragePlugin(StoragePlugin):
             await self._native_read_into(read_io, path, offset, n)
             return
         if n >= _NATIVE_WRITE_THRESHOLD:
-            read_io.buf = await self._native_read(path, offset, n)
+            read_io.buf = await self._native_read(path, offset, n, read_io)
             return
         async with aiofiles.open(path, "rb") as f:
             if offset:
@@ -109,14 +109,21 @@ class FSStoragePlugin(StoragePlugin):
         read_io.crc_algo = algo
         read_io.buf = MemoryviewStream(dst[:n])
 
-    async def _native_read(self, path: str, offset: int, n: int):
+    async def _native_read(self, path: str, offset: int, n: int, read_io=None):
         """Single GIL-released pread in a thread (native helper), landing
         in an *uninitialized* numpy buffer — preallocating via BytesIO
         would zero-fill n bytes first. The allocation itself also happens
         on the worker thread: large np.empty calls contend on the
         process's mmap lock under concurrent read page-fault traffic and
-        would stall the event loop for tens of ms each."""
+        would stall the event loop for tens of ms each.
+
+        When the request asks for a checksum (``want_crc``) it is
+        computed here on the read thread — overlapping other streams'
+        I/O — so the consume stage verifies a 4-byte value instead of
+        re-reading the buffer (sharded-shard reads use this; dense numpy
+        targets go further via the in-place ``into`` path)."""
         loop = asyncio.get_running_loop()
+        want_crc = read_io is not None and read_io.want_crc
 
         def work():
             from .. import _native
@@ -124,10 +131,20 @@ class FSStoragePlugin(StoragePlugin):
             # 4096-aligned so the native direct read preads straight into
             # this buffer (zero-copy) instead of bouncing every chunk.
             arr = _native.aligned_empty(n)
+            if want_crc:
+                got, crc, algo = _native.read_range_into(
+                    path, offset, n, arr, want_crc=True
+                )
+                return arr, got, crc, algo
             got = _read_range(path, offset, n, arr.data)
-            return arr, got
+            return arr, got, None, None
 
-        arr, got = await loop.run_in_executor(self._get_executor(), work)
+        arr, got, crc, algo = await loop.run_in_executor(
+            self._get_executor(), work
+        )
+        if want_crc and got == n:
+            read_io.crc32c = crc
+            read_io.crc_algo = algo
         view = memoryview(arr)[:got] if got != n else memoryview(arr)
         return MemoryviewStream(view)
 
