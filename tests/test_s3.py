@@ -104,3 +104,44 @@ def test_delete(plugin):
     _run(plugin.write(WriteIO(path="d", buf=b"x")))
     _run(plugin.delete("d"))
     assert ("mybucket", "some/prefix/d") not in plugin._client.objects
+
+
+def test_in_place_read_with_fused_crc(plugin):
+    """ReadIO.into lands the body directly in the destination with the
+    checksum computed off-loop; consumers then verify a 4-byte value."""
+    import numpy as np
+
+    from tpusnap import _native
+
+    payload = bytes(range(256)) * 8
+    _run(plugin.write(WriteIO(path="obj", buf=payload)))
+
+    dst = np.zeros(len(payload), dtype=np.uint8)
+    read_io = ReadIO(path="obj", into=memoryview(dst), want_crc=True)
+    _run(plugin.read(read_io))
+    assert read_io.in_place
+    assert dst.tobytes() == payload
+    assert read_io.crc32c == _native.crc32c(payload)
+    assert read_io.crc_algo == _native.checksum_algorithm()
+    assert bytes(read_io.buf.getbuffer()) == payload
+
+    # byte-ranged in-place read
+    dst2 = np.zeros(500, dtype=np.uint8)
+    read_io = ReadIO(
+        path="obj", byte_range=(100, 600), into=memoryview(dst2), want_crc=True
+    )
+    _run(plugin.read(read_io))
+    assert dst2.tobytes() == payload[100:600]
+    assert read_io.crc32c == _native.crc32c(payload[100:600])
+
+
+def test_in_place_size_mismatch_fails_loudly(plugin):
+    """A truncated stored object must raise, not silently fall back to
+    an unbudgeted full-size buffer."""
+    import numpy as np
+
+    _run(plugin.write(WriteIO(path="obj", buf=b"x" * 100)))
+    dst = np.zeros(200, dtype=np.uint8)  # manifest said 200, object has 100
+    read_io = ReadIO(path="obj", into=memoryview(dst), want_crc=True)
+    with pytest.raises(IOError, match="truncated"):
+        _run(plugin.read(read_io))

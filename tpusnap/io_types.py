@@ -118,9 +118,17 @@ class StoragePlugin(abc.ABC):
     coroutines (the scheduler keeps up to 16 requests in flight)."""
 
     # Plugins that honor ReadIO.into (bytes land in the consumer-provided
-    # destination, no scratch buffer) set this True; the scheduler then
-    # exempts such reads from the consuming-memory budget.
+    # destination) set this True; the scheduler then charges such reads
+    # only the plugin's transient overhead instead of the blob size.
     supports_in_place_reads: bool = False
+
+    def in_place_read_overhead_bytes(self, nbytes: int) -> int:
+        """Peak transient scratch memory an in-place read of ``nbytes``
+        allocates inside this plugin (drives the scheduler's consuming
+        budget). The conservative default assumes a full-size buffer;
+        plugins that stream into the destination override with their
+        actual bounce/chunk footprint."""
+        return nbytes
 
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None: ...

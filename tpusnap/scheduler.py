@@ -43,15 +43,6 @@ logger = logging.getLogger(__name__)
 
 _MAX_IO_CONCURRENCY = 16
 _MAX_CPU_CONCURRENCY = 4
-
-def _in_place_bounce_bound() -> int:
-    """Per-stream bounce memory of the native in-place read engine
-    ((qd+1) x 8 MiB chunks, see ts_read_range_into_crc): what an
-    in-place read actually costs in scheduler-visible host memory."""
-    from .knobs import get_direct_io_qd
-
-    qd = min(max(get_direct_io_qd(), 1), 8)  # native clamps identically
-    return (qd + 1) * 8 * 1024 * 1024
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_FRACTION = 0.6
 _REPORT_INTERVAL_SEC = 10.0
@@ -370,15 +361,16 @@ class _ReadPipeline:
     def __init__(self, read_req: ReadReq, storage: StoragePlugin) -> None:
         self.read_req = read_req
         self.storage = storage
-        # In-place reads allocate no scratch buffer (bytes land in the
-        # caller-owned restore target), so they are charged only the
-        # native engine's bounded per-stream bounce footprint instead of
-        # the full blob size — only plugins that honor ReadIO.into
-        # qualify. This is what lets a multi-GB tensor restore in place
-        # under a small memory budget without serializing every stream.
+        # In-place reads allocate no full-size scratch buffer (bytes land
+        # in the caller-owned restore target), so they are charged only
+        # the plugin's transient overhead — the fs engine's per-stream
+        # bounce buffers, a cloud plugin's download chunk — instead of
+        # the blob size. This is what lets a multi-GB tensor restore in
+        # place under a small memory budget without serializing every
+        # stream.
         cost = read_req.buffer_consumer.get_consuming_cost_bytes()
         if read_req.into is not None and storage.supports_in_place_reads:
-            cost = min(cost, _in_place_bounce_bound())
+            cost = min(cost, storage.in_place_read_overhead_bytes(cost))
         self.consuming_cost = cost
         self.read_io: Optional[ReadIO] = None
 

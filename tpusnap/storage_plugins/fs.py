@@ -28,6 +28,15 @@ _NATIVE_WRITE_THRESHOLD = 4 * 1024 * 1024
 class FSStoragePlugin(StoragePlugin):
     supports_in_place_reads = True
 
+    def in_place_read_overhead_bytes(self, nbytes: int) -> int:
+        """Per-stream bounce memory of the native in-place read engine
+        ((qd+1) x 8 MiB chunks, clamped to the read window — see
+        ts_read_range_into_crc)."""
+        from ..knobs import get_direct_io_qd
+
+        qd = min(max(get_direct_io_qd(), 1), 8)  # native clamps identically
+        return min(nbytes, (qd + 1) * 8 * 1024 * 1024)
+
     def __init__(self, root: str, storage_options=None) -> None:
         self.root = root
         self._dir_cache: Set[pathlib.Path] = set()
