@@ -256,3 +256,26 @@ class TestCustomArrayPrepareFunc:
                 {"m": StateDict(w=np.arange(100, dtype=np.float32))},
                 _custom_array_prepare_func=lambda p, a, tracing: a[:50],
             )
+
+
+def test_snapshot_handle_reuse_and_close(tmp_path):
+    """restore/read_object/metadata reuse one event loop + storage
+    plugin across calls; close() releases them and later calls
+    transparently re-create them."""
+    arrs = {f"w{i}": np.arange(1000, dtype=np.float32) + i for i in range(3)}
+    Snapshot.take(str(tmp_path / "s"), {"m": StateDict(**arrs)})
+    with Snapshot(str(tmp_path / "s")) as snap:
+        first = snap._resources()
+        for i in range(3):
+            out = snap.read_object(f"0/m/w{i}")
+            np.testing.assert_array_equal(out, arrs[f"w{i}"])
+        assert snap._resources() == first  # same loop + plugin reused
+        target = {"m": StateDict(**{k: np.zeros_like(v) for k, v in arrs.items()})}
+        snap.restore(target)
+        np.testing.assert_array_equal(target["m"]["w2"], arrs["w2"])
+    # context exit closed the loop
+    assert snap._cached_loop is None
+    # calls after close still work (resources re-created)
+    out = snap.read_object("0/m/w0")
+    np.testing.assert_array_equal(out, arrs["w0"])
+    snap.close()

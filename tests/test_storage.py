@@ -319,3 +319,84 @@ class TestReadInto:
                 target = {"m": StateDict(w=np.zeros_like(arr))}
                 with pytest.raises((IOError, ValueError)):
                     Snapshot(str(tmp_path / "s")).restore(target)
+
+
+class TestAbortPath:
+    """A failed read must surface the ORIGINAL error, leave no stranded
+    tasks on the (cached, reused) event loop, and leave no plugin
+    thread still writing into caller-owned memory."""
+
+    def test_failed_restore_surfaces_original_error_and_loop_reusable(
+        self, tmp_path
+    ):
+        from tpusnap._native import ChecksumError
+
+        arrs = {
+            f"w{i}": np.arange(400_000, dtype=np.float32) + i for i in range(6)
+        }
+        Snapshot.take(str(tmp_path / "s"), {"m": StateDict(**arrs)})
+        snap = Snapshot(str(tmp_path / "s"))
+        entry = snap.get_manifest()["0/m/w2"]
+        blob = str(tmp_path / "s" / "0" / "m" / "w2")
+        if not os.path.isfile(blob):
+            import glob as _glob
+
+            blob = _glob.glob(str(tmp_path / "s" / "batched" / "*"))[0]
+        off = (entry.byte_range[0] if entry.byte_range else 0) + 16
+        with open(blob, "r+b") as fh:
+            fh.seek(off)
+            b = fh.read(1)
+            fh.seek(off)
+            fh.write(bytes([b[0] ^ 0xFF]))
+
+        # Repeated fail -> reuse cycles on the same handle: the original
+        # ChecksumError (not a secondary abort artifact) must surface
+        # every time, and clean blobs must read correctly afterwards.
+        for _ in range(3):
+            with pytest.raises(ChecksumError, match="w2"):
+                snap.restore(
+                    {
+                        "m": StateDict(
+                            **{k: np.zeros_like(v) for k, v in arrs.items()}
+                        )
+                    }
+                )
+            out = snap.read_object("0/m/w5")
+            np.testing.assert_array_equal(out, arrs["w5"])
+        # After the abort drain, the plugin reports no in-flight work.
+        _, storage = snap._resources()
+        storage.drain_in_flight()
+        assert not storage.__dict__.get("_tracked_inflight")
+        snap.close()
+
+    def test_run_on_loop_drains_stranded_task(self):
+        """A BaseException escaping run_until_complete must not leave
+        the top-level task pending on the loop."""
+        import asyncio
+
+        from tpusnap.io_types import run_on_loop
+
+        loop = asyncio.new_event_loop()
+        state = {"cancelled": False}
+
+        async def work():
+            try:
+                await asyncio.sleep(60)
+            except asyncio.CancelledError:
+                state["cancelled"] = True
+                raise
+
+        task = loop.create_task(work())
+
+        # Simulate an interrupt escaping the loop machinery: stop the
+        # loop via a KeyboardInterrupt raised from a scheduled callback.
+        def boom():
+            raise KeyboardInterrupt
+
+        loop.call_later(0.05, boom)
+        with pytest.raises(KeyboardInterrupt):
+            run_on_loop(loop, task)
+        assert task.done() and state["cancelled"]
+        # The loop is clean: a fresh coroutine runs unobstructed.
+        assert loop.run_until_complete(asyncio.sleep(0, result=42)) == 42
+        loop.close()

@@ -130,6 +130,30 @@ class StoragePlugin(abc.ABC):
         actual bounce/chunk footprint."""
         return nbytes
 
+    def _submit_tracked(self, executor, fn):
+        """Run ``fn`` on ``executor``, tracked for ``drain_in_flight``.
+        Plugins route any thread-offloaded work that writes into
+        caller-owned buffers through this."""
+        inflight = self.__dict__.setdefault("_tracked_inflight", set())
+        future = executor.submit(fn)
+        inflight.add(future)
+        future.add_done_callback(inflight.discard)
+        return asyncio.wrap_future(future)
+
+    def drain_in_flight(self) -> None:
+        """Block until worker-thread I/O this plugin offloaded via
+        ``_submit_tracked`` has finished. Cancelling an asyncio task
+        does NOT interrupt its executor work — after an aborted read, a
+        plugin thread may still be writing into a caller-owned in-place
+        destination. The scheduler's abort path calls this before
+        re-raising so no stale write races the caller's error
+        handling."""
+        import concurrent.futures
+
+        pending = list(self.__dict__.get("_tracked_inflight", ()))
+        if pending:
+            concurrent.futures.wait(pending)
+
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None: ...
 
@@ -165,9 +189,31 @@ class StoragePlugin(abc.ABC):
         _run(self.close(), event_loop)
 
 
+def run_on_loop(event_loop: asyncio.AbstractEventLoop, coro):
+    """``run_until_complete`` that cannot strand tasks on the loop.
+
+    A BaseException delivered inside the loop machinery (Ctrl-C between
+    callbacks) escapes ``run_until_complete`` without unwinding the
+    top-level coroutine; on a per-call loop the subsequent close()
+    destroyed the orphan, but on a REUSED loop (cached Snapshot
+    resources) the next ``run_until_complete`` would resume it —
+    writing into the previous call's buffers. Cancel and drain the
+    top-level task before re-raising."""
+    task = event_loop.create_task(coro) if asyncio.iscoroutine(coro) else coro
+    try:
+        return event_loop.run_until_complete(task)
+    except BaseException:
+        task.cancel()
+        try:
+            event_loop.run_until_complete(task)
+        except BaseException:
+            pass
+        raise
+
+
 def _run(coro, event_loop: Optional[asyncio.AbstractEventLoop]) -> None:
     if event_loop is not None:
-        event_loop.run_until_complete(coro)
+        run_on_loop(event_loop, coro)
     else:
         asyncio.run(coro)
 

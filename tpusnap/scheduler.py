@@ -36,7 +36,7 @@ from typing import Awaitable, List, Optional, Set
 
 import psutil
 
-from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
+from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq, run_on_loop
 from .knobs import get_memory_budget_override_bytes
 
 logger = logging.getLogger(__name__)
@@ -232,7 +232,10 @@ class PendingIOWork:
             self.reporter.summarize()
 
     def sync_complete(self, event_loop: asyncio.AbstractEventLoop) -> None:
-        event_loop.run_until_complete(self.complete())
+        # run_on_loop: the commit path reuses this loop for the metadata
+        # write and close afterwards — a stranded task would be resumed
+        # mid-commit.
+        run_on_loop(event_loop, self.complete())
 
 
 class _WritePipeline:
@@ -352,8 +355,9 @@ def sync_execute_write_reqs(
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
 ) -> PendingIOWork:
-    return event_loop.run_until_complete(
-        execute_write_reqs(write_reqs, storage, memory_budget_bytes, rank)
+    return run_on_loop(
+        event_loop,
+        execute_write_reqs(write_reqs, storage, memory_budget_bytes, rank),
     )
 
 
@@ -448,6 +452,20 @@ async def execute_read_reqs(
                 "consume": len(consume_tasks),
             }
             reporter.budget_remaining = budget
+    except BaseException:
+        # Mirror the write path: a failed request (e.g. checksum
+        # mismatch) must not abandon in-flight tasks — orphans would be
+        # resumed by the NEXT run_until_complete on a reused event loop
+        # and write into a previous call's caller-owned buffers.
+        await _cancel_and_drain(read_tasks | consume_tasks)
+        # Task cancellation does not interrupt run_in_executor work: a
+        # plugin thread may still be mid-write into a caller-owned
+        # in-place destination. Wait it out (off-loop) before the error
+        # reaches the caller.
+        await asyncio.get_running_loop().run_in_executor(
+            None, storage.drain_in_flight
+        )
+        raise
     finally:
         executor.shutdown(wait=True)
     reporter.summarize()
@@ -460,6 +478,7 @@ def sync_execute_read_reqs(
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
 ) -> None:
-    event_loop.run_until_complete(
-        execute_read_reqs(read_reqs, storage, memory_budget_bytes, rank)
+    run_on_loop(
+        event_loop,
+        execute_read_reqs(read_reqs, storage, memory_budget_bytes, rank),
     )

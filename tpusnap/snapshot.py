@@ -72,6 +72,14 @@ SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
 
 
 class Snapshot:
+    """Handle on a snapshot. The event loop and storage plugin are
+    created lazily on first use and REUSED across restore/read_object/
+    metadata calls (a GCS plugin holds an authorized session — paying
+    its construction per ``read_object`` in a loop is pure overhead;
+    the reference rebuilds both per call, snapshot.py:437-520). Call
+    ``close()`` (or use the handle as a context manager) to release
+    them; they are also re-created transparently after a close."""
+
     def __init__(
         self,
         path: str,
@@ -82,6 +90,73 @@ class Snapshot:
         self._storage_options = storage_options
         self._comm = comm
         self._metadata: Optional[SnapshotMetadata] = None
+        self._cached_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._cached_storage: Optional[StoragePlugin] = None
+        # restore/read_object/metadata serialize on this lock: they share
+        # the cached loop, and a second run_until_complete on a running
+        # loop raises. Threads wanting concurrent reads use separate
+        # Snapshot handles (each carries its own loop + plugin).
+        self._op_lock = threading.RLock()
+
+    def _resources(self):
+        """(event_loop, storage), cached across calls. Callers hold
+        ``_op_lock`` for the duration of their use."""
+        if self._cached_loop is None or self._cached_loop.is_closed():
+            self._cached_loop = asyncio.new_event_loop()
+            self._cached_storage = None
+        if self._cached_storage is None:
+            self._cached_storage = url_to_storage_plugin_in_event_loop(
+                self.path, self._cached_loop, self._storage_options
+            )
+        return self._cached_loop, self._cached_storage
+
+    def close(self) -> None:
+        """Release the cached storage plugin and event loop."""
+        with self._op_lock:
+            # GC may run __del__ from inside another running event loop
+            # (e.g. while a different snapshot's coroutines execute);
+            # run_until_complete is illegal there, so skip the graceful
+            # storage close and only drop references.
+            try:
+                asyncio.get_running_loop()
+                in_async_context = True
+            except RuntimeError:
+                in_async_context = False
+            if (
+                not in_async_context
+                and self._cached_storage is not None
+                and self._cached_loop is not None
+                and not self._cached_loop.is_closed()
+                and not self._cached_loop.is_running()
+            ):
+                try:
+                    self._cached_storage.sync_close(self._cached_loop)
+                except Exception:
+                    pass
+            self._cached_storage = None
+            if self._cached_loop is not None:
+                try:
+                    if not self._cached_loop.is_running():
+                        self._cached_loop.close()
+                except Exception:
+                    pass
+            self._cached_loop = None
+
+    def __enter__(self) -> "Snapshot":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        # Best-effort: `Snapshot(path).restore(...)` temporaries are
+        # refcount-collected at statement end, so the common drop-the-
+        # handle pattern releases its loop and storage promptly without
+        # an explicit close().
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------ take
 
@@ -190,42 +265,39 @@ class Snapshot:
         own collectives inside ``load_state_dict``."""
         comm = get_communicator(self._comm)
         _validate_app_state(app_state)
-        event_loop = asyncio.new_event_loop()
-        try:
-            storage = url_to_storage_plugin_in_event_loop(
-                self.path, event_loop, self._storage_options
-            )
-            metadata = self._get_metadata(storage, event_loop)
-            memory_budget = get_process_memory_budget_bytes(comm)
+        with self._op_lock:
+            self._restore_locked(app_state, comm, per_key_barrier)
 
-            multi = comm.world_size > 1
+    def _restore_locked(self, app_state, comm, per_key_barrier) -> None:
+        event_loop, storage = self._resources()
+        metadata = self._get_metadata(storage, event_loop)
+        memory_budget = get_process_memory_budget_bytes(comm)
+
+        multi = comm.world_size > 1
+        if per_key_barrier and multi:
+            keys = _gather_keys(comm, sorted(app_state.keys()))
+        else:
+            keys = sorted(app_state.keys())
+        # RNG state is restored last so that loading other statefuls
+        # cannot perturb it (reference snapshot.py:473-481).
+        rng_keys = [
+            k for k in keys if isinstance(app_state.get(k), RNGState)
+        ]
+        for key in [k for k in keys if k not in rng_keys] + rng_keys:
             if per_key_barrier and multi:
-                keys = _gather_keys(comm, sorted(app_state.keys()))
-            else:
-                keys = sorted(app_state.keys())
-            # RNG state is restored last so that loading other statefuls
-            # cannot perturb it (reference snapshot.py:473-481).
-            rng_keys = [
-                k for k in keys if isinstance(app_state.get(k), RNGState)
-            ]
-            for key in [k for k in keys if k not in rng_keys] + rng_keys:
-                if per_key_barrier and multi:
-                    comm.barrier()
-                stateful = app_state.get(key)
-                if stateful is None:
-                    continue
-                _load_stateful(
-                    stateful=stateful,
-                    key=key,
-                    metadata=metadata,
-                    rank=comm.rank,
-                    storage=storage,
-                    memory_budget=memory_budget,
-                    event_loop=event_loop,
-                )
-            storage.sync_close(event_loop)
-        finally:
-            event_loop.close()
+                comm.barrier()
+            stateful = app_state.get(key)
+            if stateful is None:
+                continue
+            _load_stateful(
+                stateful=stateful,
+                key=key,
+                metadata=metadata,
+                rank=comm.rank,
+                storage=storage,
+                memory_budget=memory_budget,
+                event_loop=event_loop,
+            )
 
     # ----------------------------------------------------------- random access
 
@@ -243,47 +315,42 @@ class Snapshot:
             raise ValueError(
                 f"Invalid manifest path {path!r} (expected '<rank>/<path>')"
             )
-        event_loop = asyncio.new_event_loop()
-        try:
-            storage = url_to_storage_plugin_in_event_loop(
-                self.path, event_loop, self._storage_options
+        with self._op_lock:
+            return self._read_object_locked(
+                path, rank_str, logical_path, obj_out, memory_budget_bytes, comm
             )
-            metadata = self._get_metadata(storage, event_loop)
-            local_manifest = get_manifest_for_rank(metadata, int(rank_str))
-            if logical_path not in local_manifest:
-                raise KeyError(f"{path!r} not found in snapshot manifest")
-            entry = local_manifest[logical_path]
-            if is_container_entry(entry):
-                raise ValueError(
-                    f"{path!r} is a container; read its leaves individually"
-                )
-            read_reqs, fut = prepare_read(
-                entry,
-                obj_out,
-                buffer_size_limit_bytes=memory_budget_bytes,
-                logical_path=logical_path,
+
+    def _read_object_locked(
+        self, path, rank_str, logical_path, obj_out, memory_budget_bytes, comm
+    ) -> Any:
+        event_loop, storage = self._resources()
+        metadata = self._get_metadata(storage, event_loop)
+        local_manifest = get_manifest_for_rank(metadata, int(rank_str))
+        if logical_path not in local_manifest:
+            raise KeyError(f"{path!r} not found in snapshot manifest")
+        entry = local_manifest[logical_path]
+        if is_container_entry(entry):
+            raise ValueError(
+                f"{path!r} is a container; read its leaves individually"
             )
-            budget = memory_budget_bytes or get_process_memory_budget_bytes(comm)
-            sync_execute_read_reqs(read_reqs, storage, budget, comm.rank, event_loop)
-            storage.sync_close(event_loop)
-            return fut.obj
-        finally:
-            event_loop.close()
+        read_reqs, fut = prepare_read(
+            entry,
+            obj_out,
+            buffer_size_limit_bytes=memory_budget_bytes,
+            logical_path=logical_path,
+        )
+        budget = memory_budget_bytes or get_process_memory_budget_bytes(comm)
+        sync_execute_read_reqs(read_reqs, storage, budget, comm.rank, event_loop)
+        return fut.obj
 
     # -------------------------------------------------------------- metadata
 
     @property
     def metadata(self) -> SnapshotMetadata:
         if self._metadata is None:
-            event_loop = asyncio.new_event_loop()
-            try:
-                storage = url_to_storage_plugin_in_event_loop(
-                    self.path, event_loop, self._storage_options
-                )
+            with self._op_lock:
+                event_loop, storage = self._resources()
                 self._metadata = self._get_metadata(storage, event_loop)
-                storage.sync_close(event_loop)
-            finally:
-                event_loop.close()
         return self._metadata
 
     def get_manifest(self) -> Manifest:

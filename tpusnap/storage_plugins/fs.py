@@ -97,7 +97,6 @@ class FSStoragePlugin(StoragePlugin):
         destination (the restore target's memory) with the checksum fused
         into the native copy-out — no scratch buffer, no separate verify
         pass, no deserialize+copy pass in the consume stage."""
-        loop = asyncio.get_running_loop()
         dst = read_io.into
 
         def work():
@@ -107,7 +106,7 @@ class FSStoragePlugin(StoragePlugin):
                 path, offset, n, dst, want_crc=read_io.want_crc
             )
 
-        got, crc, algo = await loop.run_in_executor(self._get_executor(), work)
+        got, crc, algo = await self._submit_tracked(self._get_executor(), work)
         if got != n:
             raise IOError(
                 f"short read: got {got} of {n} bytes at offset {offset} "
@@ -131,7 +130,6 @@ class FSStoragePlugin(StoragePlugin):
         I/O — so the consume stage verifies a 4-byte value instead of
         re-reading the buffer (sharded-shard reads use this; dense numpy
         targets go further via the in-place ``into`` path)."""
-        loop = asyncio.get_running_loop()
         want_crc = read_io is not None and read_io.want_crc
 
         def work():
@@ -148,9 +146,7 @@ class FSStoragePlugin(StoragePlugin):
             got = _read_range(path, offset, n, arr.data)
             return arr, got, None, None
 
-        arr, got, crc, algo = await loop.run_in_executor(
-            self._get_executor(), work
-        )
+        arr, got, crc, algo = await self._submit_tracked(self._get_executor(), work)
         if want_crc and got == n:
             read_io.crc32c = crc
             read_io.crc_algo = algo

@@ -351,7 +351,7 @@ class GCSStoragePlugin(StoragePlugin):
                     return _native.crc32c(dst[lo : lo + len(data)], crc)
                 return None
 
-            new_crc = await loop.run_in_executor(self._executor, land)
+            new_crc = await self._submit_tracked(self._executor, land)
             if crc is not None:
                 crc = new_crc
         read_io.in_place = True

@@ -29,9 +29,19 @@ class S3StoragePlugin(StoragePlugin):
         self._client = None
         self._client_ctx = None
         self._storage_options = storage_options or {}
+        self._executor = None
         # The aiobotocore import is deferred to first use so construction
         # works without the package — tests inject a stub via _client, and
         # environments without S3 can still import/route every plugin.
+
+    def _get_executor(self):
+        if self._executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="tpusnap-s3"
+            )
+        return self._executor
 
     async def _get_client(self):
         if self._client is None:
@@ -86,8 +96,10 @@ class S3StoragePlugin(StoragePlugin):
             # In-place delivery: bytes land in the restore target, the
             # checksum is computed once, and the consume stage verifies
             # a 4-byte value with no deserialize/copy pass. The copy +
-            # hash run in a worker thread: blocking the event loop for
-            # a multi-GB memcpy would stall every concurrent stream.
+            # hash run in a worker thread (blocking the event loop for
+            # a multi-GB memcpy would stall every concurrent stream),
+            # tracked so an aborted restore can wait it out before the
+            # error reaches the caller.
             from .. import _native
 
             def deliver():
@@ -96,7 +108,7 @@ class S3StoragePlugin(StoragePlugin):
                     read_io.crc32c = _native.crc32c(body)
                     read_io.crc_algo = _native.checksum_algorithm()
 
-            await asyncio.get_running_loop().run_in_executor(None, deliver)
+            await self._submit_tracked(self._get_executor(), deliver)
             read_io.in_place = True
             read_io.buf = MemoryviewStream(read_io.into[: len(body)])
             return
@@ -111,3 +123,6 @@ class S3StoragePlugin(StoragePlugin):
             await self._client_ctx.__aexit__(None, None, None)
             self._client = None
             self._client_ctx = None
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
