@@ -81,7 +81,11 @@ def url_to_storage_plugin_in_event_loop(
     event_loop: asyncio.AbstractEventLoop,
     storage_options: Optional[Dict[str, Any]] = None,
 ) -> StoragePlugin:
+    from .io_types import run_on_loop
+
     async def _create() -> StoragePlugin:
         return url_to_storage_plugin(url_path, storage_options)
 
-    return event_loop.run_until_complete(_create())
+    # run_on_loop: the loop may be a cached, reused one (Snapshot
+    # resources) — an interrupt must not strand the creation task.
+    return run_on_loop(event_loop, _create())

@@ -325,7 +325,6 @@ class GCSStoragePlugin(StoragePlugin):
 
         dst = read_io.into
         n = end - start
-        loop = asyncio.get_running_loop()
         crc: Optional[int] = 0 if read_io.want_crc else None
         for offset in range(start, end, _DOWNLOAD_CHUNK_SIZE):
             chunk_end = min(offset + _DOWNLOAD_CHUNK_SIZE, end)
