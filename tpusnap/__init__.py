@@ -24,8 +24,11 @@ try:  # pragma: no cover - import surface grows as modules land
         to_host_offload,
     )
     from .rss_profiler import measure_rss_deltas  # noqa: F401
+    from .inspect import ScrubReport, verify_snapshot  # noqa: F401
 
     __all__ += [
+        "ScrubReport",
+        "verify_snapshot",
         "Snapshot",
         "PendingSnapshot",
         "Stateful",

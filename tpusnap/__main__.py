@@ -1,0 +1,151 @@
+"""Snapshot inspection CLI: ``python -m tpusnap {info,ls,verify,cat} ...``
+
+Operational tooling over the manifest + checksum machinery (no reference
+counterpart — torchsnapshot ships no CLI and no integrity checking):
+
+  info   PATH           snapshot version, world size, size breakdown
+  ls     PATH [-l]      list manifest entries (one line per logical path)
+  verify PATH           stream-verify every blob against recorded CRCs
+  cat    PATH MANIFEST_PATH   read one object (``read_object``) and print it
+
+Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from .inspect import entry_nbytes, entry_verifiable, verify_snapshot
+from .manifest import (
+    ChunkedTensorEntry,
+    ObjectEntry,
+    PrimitiveEntry,
+    ShardedEntry,
+    TensorEntry,
+    is_container_entry,
+)
+from .snapshot import Snapshot
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def _entry_desc(entry) -> str:
+    if isinstance(entry, TensorEntry):
+        return f"tensor  {entry.dtype}{entry.shape}"
+    if isinstance(entry, ChunkedTensorEntry):
+        return f"chunked {entry.dtype}{entry.shape} ({len(entry.chunks)} chunks)"
+    if isinstance(entry, ShardedEntry):
+        return f"sharded {entry.dtype}{entry.shape} ({len(entry.shards)} shards)"
+    if isinstance(entry, ObjectEntry):
+        return f"object  {entry.obj_type}"
+    if isinstance(entry, PrimitiveEntry):
+        val = entry.readable if entry.readable is not None else entry.serialized_value
+        return f"primitive {entry.dtype}={val!r}"
+    return entry.type
+
+
+def cmd_info(args) -> int:
+    md = Snapshot(args.path).metadata
+    counts: dict = {}
+    total = 0
+    for p, e in md.manifest.items():
+        if is_container_entry(e):
+            continue
+        counts[e.type] = counts.get(e.type, 0) + 1
+        total += entry_nbytes(e)
+    print(f"path:        {args.path}")
+    print(f"version:     {md.version}")
+    print(f"world_size:  {md.world_size}")
+    print(f"payload:     {_fmt_bytes(total)}")
+    print(f"entries:     {sum(counts.values())}")
+    for t, c in sorted(counts.items()):
+        print(f"  {t:14s} {c}")
+    return 0
+
+
+def cmd_ls(args) -> int:
+    md = Snapshot(args.path).metadata
+    for p in sorted(md.manifest):
+        e = md.manifest[p]
+        if is_container_entry(e) and not args.all:
+            continue
+        if args.long:
+            n = entry_nbytes(e)
+            crc = "✓" if entry_verifiable(e) else " "
+            print(f"{_fmt_bytes(n):>10s}  {crc}  {p}  [{_entry_desc(e)}]")
+        else:
+            print(p)
+    return 0
+
+
+def cmd_verify(args) -> int:
+    report = verify_snapshot(args.path)
+    for f in report.failures:
+        print(
+            f"CORRUPT  {f.manifest_path} ({f.location}"
+            + (f", {f.detail}" if f.detail else "")
+            + ")",
+            file=sys.stderr,
+        )
+    if args.verbose:
+        for u in report.unverified_blobs:
+            print(f"UNVERIFIED  {u.manifest_path}: {u.detail}")
+    print(report.summary())
+    return 0 if report.clean else 2
+
+
+def cmd_cat(args) -> int:
+    out = Snapshot(args.path).read_object(args.manifest_path)
+    if isinstance(out, np.ndarray):
+        print(f"# {out.dtype}{list(out.shape)}")
+        print(np.array2string(out, threshold=64, edgeitems=3))
+    else:
+        print(repr(out))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpusnap", description=__doc__.split("\n")[0]
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("info", help="snapshot summary")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_info)
+
+    p = sub.add_parser("ls", help="list manifest entries")
+    p.add_argument("path")
+    p.add_argument("-l", "--long", action="store_true", help="sizes/types")
+    p.add_argument("-a", "--all", action="store_true", help="include containers")
+    p.set_defaults(fn=cmd_ls)
+
+    p = sub.add_parser("verify", help="integrity scrub (checksum every blob)")
+    p.add_argument("path")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.set_defaults(fn=cmd_verify)
+
+    p = sub.add_parser("cat", help="print one object")
+    p.add_argument("path")
+    p.add_argument("manifest_path", help='"<rank>/<logical_path>"')
+    p.set_defaults(fn=cmd_cat)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (RuntimeError, KeyError, ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

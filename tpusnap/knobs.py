@@ -185,3 +185,9 @@ def override_direct_io_disabled(disabled: bool) -> Generator[None, None, None]:
 def override_checksum_disabled(disabled: bool) -> Generator[None, None, None]:
     with _override_env(_DISABLE_CHECKSUM_ENV_VAR, "1" if disabled else "0"):
         yield
+
+
+@contextlib.contextmanager
+def override_tile_checksum_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_TILE_CHECKSUM_ENV_VAR, str(nbytes)):
+        yield

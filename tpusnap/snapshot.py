@@ -343,6 +343,20 @@ class Snapshot:
         sync_execute_read_reqs(read_reqs, storage, budget, comm.rank, event_loop)
         return fut.obj
 
+    # ------------------------------------------------------------- integrity
+
+    def verify(self):
+        """Stream-verify every blob of this snapshot against the checksums
+        recorded in its manifest (see :mod:`tpusnap.inspect`). Returns a
+        :class:`tpusnap.inspect.ScrubReport`; ``report.clean`` is False on
+        any corruption/truncation. Also exposed as
+        ``python -m tpusnap verify <path>``."""
+        from .inspect import verify_snapshot
+
+        return verify_snapshot(
+            self.path, self._storage_options, metadata=self._metadata
+        )
+
     # -------------------------------------------------------------- metadata
 
     @property
