@@ -95,3 +95,37 @@ class TestOrbaxTrick:
         snapshot = pending.wait()
         out = ckpt.restore(snapshot.path, {"a": jnp.zeros(8)})
         np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(8.0))
+
+
+class TestAdviseHugepages:
+    """advise_hugepages is best-effort: buffers stay fully usable whether
+    or not the host supports anonymous THP."""
+
+    def test_advised_buffers_usable(self):
+        import numpy as np
+
+        from tpusnap import _native
+
+        big = _native.aligned_empty(8 << 20)  # above the 4 MiB threshold
+        np.asarray(big)[:] = 7
+        assert (np.asarray(big) == 7).all()
+        small = _native.aligned_empty(1024)  # below: no-op path
+        np.asarray(small)[:] = 1
+        assert (np.asarray(small) == 1).all()
+
+    def test_advise_arbitrary_arrays(self):
+        import numpy as np
+
+        from tpusnap import _native
+
+        arr = np.random.default_rng(0).standard_normal(1 << 21)
+        before = arr.copy()
+        _native.advise_hugepages(arr)  # must not perturb contents
+        assert (arr == before).all()
+        _native.advise_hugepages(np.empty(0, np.uint8))  # empty: no-op
+        # dtypes without buffer protocol (memoryview() raises on these)
+        import ml_dtypes
+
+        bf16 = np.ones(1 << 21, dtype=ml_dtypes.bfloat16)
+        _native.advise_hugepages(bf16)
+        assert (bf16 == 1).all()

@@ -202,6 +202,13 @@ def test_cli_info_ls_cat_verify(tmp_path, capsys):
     assert cli_main(["info", str(tmp_path / "nosnap")]) == 1
     assert "error:" in capsys.readouterr().err
 
+    # usage errors exit 1 (argparse's default of 2 would collide with
+    # "2 = corruption found"); --help stays 0
+    assert cli_main(["verify", "--bogus-flag", path]) == 1
+    capsys.readouterr()
+    assert cli_main(["--help"]) == 0
+    capsys.readouterr()
+
 
 def test_cli_module_invocation(tmp_path):
     """`python -m tpusnap verify` works as a real subprocess entry point."""

@@ -139,7 +139,12 @@ def main(argv=None) -> int:
     p.add_argument("manifest_path", help='"<rank>/<logical_path>"')
     p.set_defaults(fn=cmd_cat)
 
-    args = parser.parse_args(argv)
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on usage errors, which would collide with the
+        # documented "2 = corruption found" contract; --help stays 0.
+        return 0 if e.code in (0, None) else 1
     try:
         return args.fn(args)
     except (RuntimeError, KeyError, ValueError, OSError) as e:

@@ -203,16 +203,85 @@ def _ptr(buf) -> Tuple[int, np.ndarray]:
     return arr.ctypes.data, arr
 
 
+_MADV_HUGEPAGE = 14
+_PAGE = 4096
+_libc: Optional[ctypes.CDLL] = None
+_libc_failed = False
+
+
+def advise_hugepages(buf) -> None:
+    """Best-effort ``madvise(MADV_HUGEPAGE)`` on a buffer's pages.
+
+    Restores into freshly allocated destinations pay a first-touch
+    page-fault per 4 KiB; on hosts with anonymous THP available
+    (``transparent_hugepage=madvise``, the common TPU-VM configuration)
+    advising large buffers tpusnap allocates itself (read scratch,
+    tiled-read/shard destinations, slabs, clones) lets them fault as
+    2 MiB pages — ~500x fewer faults on the restore path. Purely
+    advisory: on kernels without anon THP (some virtualized guests,
+    including this dev host) the call succeeds but changes nothing, and
+    any failure (non-Linux, tiny buffer) is silently ignored."""
+    global _libc, _libc_failed
+    if _libc_failed:
+        return
+    if _libc is None:
+        try:
+            lc = ctypes.CDLL(None, use_errno=True)
+            lc.madvise.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_int,
+            ]
+            lc.madvise.restype = ctypes.c_int
+            _libc = lc
+        except Exception:
+            # Only libc/symbol unavailability latches the kill flag;
+            # per-buffer oddities below must not disable the advice for
+            # the rest of the process.
+            _libc_failed = True
+            return
+    try:
+        if isinstance(buf, np.ndarray):
+            # ndarray path works for dtypes with no buffer protocol too
+            # (bf16/fp8 ml_dtypes arrays reject memoryview()).
+            addr, nbytes, keep = buf.ctypes.data, buf.nbytes, buf
+        else:
+            mv = memoryview(buf)
+            nbytes = mv.nbytes
+            addr, keep = (0, None) if nbytes == 0 else _ptr(mv)
+        if nbytes < (4 << 20):
+            return
+        start = (addr + _PAGE - 1) & ~(_PAGE - 1)
+        end = (addr + nbytes) & ~(_PAGE - 1)
+        if end > start:
+            _libc.madvise(start, end - start, _MADV_HUGEPAGE)
+        del keep
+    except Exception:
+        return
+
+
+def empty_advised(shape, dtype) -> np.ndarray:
+    """``np.empty`` + ``advise_hugepages``: the allocation for any large
+    fresh destination tpusnap creates itself (tiled-read/chunk/shard
+    buffers, owning copies)."""
+    out = np.empty(shape, dtype=dtype)
+    advise_hugepages(out)
+    return out
+
+
 def aligned_empty(nbytes: int, align: int = 4096) -> np.ndarray:
     """Uninitialized uint8 buffer whose data pointer is ``align``-aligned.
 
     Buffers tpusnap allocates itself (batcher slabs, async-snapshot
     clones, staged copies) are aligned so the O_DIRECT writer can pwrite
     straight from them — the zero-copy branch of ts_write_file_direct2 —
-    instead of bouncing every chunk through an aligned copy."""
+    instead of bouncing every chunk through an aligned copy. Large
+    buffers are THP-advised (``advise_hugepages``) before first touch."""
     raw = np.empty(nbytes + align, dtype=np.uint8)
     off = (-raw.ctypes.data) % align
-    return raw[off : off + nbytes]
+    out = raw[off : off + nbytes]
+    advise_hugepages(out)
+    return out
 
 
 def write_file(path: str, buf) -> None:

@@ -260,6 +260,9 @@ def verify_snapshot(
     path: str,
     storage_options: Optional[Dict[str, Any]] = None,
     metadata: Optional[SnapshotMetadata] = None,
+    resources: Optional[
+        Tuple[asyncio.AbstractEventLoop, StoragePlugin]
+    ] = None,
 ) -> ScrubReport:
     """Stream-verify every blob of the snapshot at ``path`` against the
     checksums recorded in its manifest.
@@ -267,16 +270,24 @@ def verify_snapshot(
     Returns a :class:`ScrubReport`; ``report.clean`` is False when any
     range failed (bit-rot, truncation, or a missing blob). Peak memory is
     one blob range — tile-sized (16 MiB class) for large arrays carrying
-    tile checksums, the blob size otherwise.
+    tile checksums, the blob size otherwise. ``resources`` lets a caller
+    that already holds a (loop, storage) pair — ``Snapshot.verify`` reuses
+    its cached ones — skip plugin construction; they are left open.
     """
     from .storage_plugin import url_to_storage_plugin_in_event_loop
 
     report = ScrubReport()
-    event_loop = asyncio.new_event_loop()
+    owns_resources = resources is None
+    if owns_resources:
+        event_loop = asyncio.new_event_loop()
+        storage = None
+    else:
+        event_loop, storage = resources
     try:
-        storage = url_to_storage_plugin_in_event_loop(
-            path, event_loop, storage_options
-        )
+        if storage is None:
+            storage = url_to_storage_plugin_in_event_loop(
+                path, event_loop, storage_options
+            )
         try:
             if metadata is None:
                 from .snapshot import SNAPSHOT_METADATA_FNAME
@@ -299,7 +310,9 @@ def verify_snapshot(
                     report.unverified += 1
                     report.unverified_blobs.append(check)
         finally:
-            storage.sync_close(event_loop)
+            if owns_resources:
+                storage.sync_close(event_loop)
     finally:
-        event_loop.close()
+        if owns_resources:
+            event_loop.close()
     return report

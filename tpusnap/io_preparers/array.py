@@ -338,6 +338,17 @@ def _maybe_verify(buf: BufferType, checksum: Optional[str], location: str) -> No
     _native.verify_checksum(memoryview(buf).cast("B"), checksum, location)
 
 
+def _owning_copy(src: np.ndarray) -> np.ndarray:
+    """A copy of ``src`` that owns its memory, faulted as hugepages when
+    large (np.copy would first-touch a multi-GB destination 4 KiB at a
+    time, which on few-core hosts rivals the I/O cost)."""
+    from .. import _native
+
+    out = _native.empty_advised(src.shape, src.dtype)
+    np.copyto(out, src)
+    return out
+
+
 def materialize_array(
     entry: TensorEntry, buf: BufferType, obj_out: Optional[ArrayLike]
 ) -> ArrayLike:
@@ -350,14 +361,14 @@ def materialize_array(
         ):
             np.copyto(obj_out, src)
             return obj_out
-        return src.copy()
+        return _owning_copy(src)
     if isinstance(obj_out, jax.Array):
         # Restore with the target's sharding/placement. device_put is async;
         # XLA overlaps the HtoD DMA with subsequent reads.
         return jax.device_put(src, obj_out.sharding)
     # No target: plain host array (owns its memory — `src` aliases the
     # read buffer which is about to be released).
-    return src.copy()
+    return _owning_copy(src)
 
 
 def trace_array_prepare(
@@ -508,8 +519,12 @@ class ArrayIOPreparer:
             in_place = True
         else:
             from ..serialization import string_to_dtype
+            from .. import _native
 
-            host_out = np.empty(shape, dtype=string_to_dtype(entry.dtype))
+            # Fresh multi-GB destination: fault as hugepages, not 4 KiB
+            # pages — first-touch cost during the tile reads otherwise
+            # rivals the I/O itself on few-core hosts.
+            host_out = _native.empty_advised(shape, string_to_dtype(entry.dtype))
             in_place = False
 
         base_offset = entry.byte_range[0] if entry.byte_range is not None else 0

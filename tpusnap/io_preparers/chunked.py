@@ -123,7 +123,11 @@ class ChunkedArrayIOPreparer:
             host_out = obj_out
             in_place = True
         else:
-            host_out = np.empty(shape, dtype=string_to_dtype(entry.dtype))
+            from .. import _native
+
+            # Chunked entries are >512 MB by construction: fault the fresh
+            # destination as hugepages (see _native.advise_hugepages).
+            host_out = _native.empty_advised(shape, string_to_dtype(entry.dtype))
             in_place = False
 
         remaining = {"count": len(entry.chunks)}

@@ -267,7 +267,9 @@ class _Piece:
         if buf is None:
             with self._alloc_lock:
                 if self._buf is None:
-                    self._buf = np.empty(self.sizes, dtype=self._np_dtype)
+                    from .. import _native
+
+                    self._buf = _native.empty_advised(self.sizes, self._np_dtype)
                 buf = self._buf
         return buf
 

@@ -353,9 +353,14 @@ class Snapshot:
         ``python -m tpusnap verify <path>``."""
         from .inspect import verify_snapshot
 
-        return verify_snapshot(
-            self.path, self._storage_options, metadata=self._metadata
-        )
+        with self._op_lock:
+            event_loop, storage = self._resources()
+            return verify_snapshot(
+                self.path,
+                self._storage_options,
+                metadata=self._metadata,
+                resources=(event_loop, storage),
+            )
 
     # -------------------------------------------------------------- metadata
 
