@@ -191,3 +191,45 @@ def test_tile_checksums_combine_to_whole(tmp_path):
     assert entry.tile_checksums
     algo, _, value = entry.checksum.partition(":")
     assert int(value, 16) == (_native.crc32c(arr.tobytes()) & 0xFFFFFFFF)
+
+
+def test_async_take_fused_clone_checksums_match_sync(tmp_path):
+    """The async path records checksums inside the defensive-clone pass
+    (_native.memcpy_crc_tiles); the values (incl. tile grain) must be
+    byte-identical to the sync path's hash pass, and the snapshot must
+    scrub clean."""
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict, verify_snapshot
+    from tpusnap.knobs import (
+        override_batching_disabled,
+        override_tile_checksum_bytes,
+    )
+
+    rng = np.random.default_rng(7)
+    state = {
+        "big": rng.standard_normal((2048, 64)).astype(np.float32),
+        "small": rng.standard_normal(32).astype(np.float32),
+    }
+    with override_tile_checksum_bytes(128 * 1024), override_batching_disabled(
+        True
+    ):
+        sync_path = str(tmp_path / "sync")
+        Snapshot.take(sync_path, {"app": StateDict(**state)})
+        async_path = str(tmp_path / "async")
+        Snapshot.async_take(async_path, {"app": StateDict(**state)}).wait()
+
+    sm = Snapshot(sync_path).get_manifest()
+    am = Snapshot(async_path).get_manifest()
+    assert set(sm) == set(am)
+    checked = 0
+    for p, se in sm.items():
+        ae = am[p]
+        for field in ("checksum", "tile_rows", "tile_checksums"):
+            if hasattr(se, field):
+                assert getattr(se, field) == getattr(ae, field), (p, field)
+                checked += 1
+    assert checked > 0
+    big_entry = am["0/app/big"]
+    assert big_entry.tile_checksums and len(big_entry.tile_checksums) > 1
+    assert verify_snapshot(async_path).clean

@@ -179,6 +179,15 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_int,
     ]
     lib.ts_memcpy_par.restype = None
+    lib.ts_memcpy_crc_tiles.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_int,
+    ]
+    lib.ts_memcpy_crc_tiles.restype = None
     lib.ts_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32]
     lib.ts_crc32c.restype = ctypes.c_uint32
     lib.ts_crc32c_combine.argtypes = [
@@ -469,6 +478,41 @@ def memcpy(dst, src, nthreads: int = 4) -> None:
     src_ptr, src_keep = _ptr(src_mv)
     lib.ts_memcpy_par(dst_ptr, src_ptr, dst_mv.nbytes, nthreads)
     del dst_keep, src_keep
+
+
+def memcpy_crc_tiles(dst, src, tile_nbytes: int, nthreads: int = 4) -> list:
+    """Copy ``src`` into ``dst`` while computing an independent seed-0
+    checksum per ``tile_nbytes`` bytes — ONE memory pass for what would
+    otherwise be a hash pass plus a clone pass (the async-snapshot
+    staging path). Returns the per-tile checksum values (one entry, the
+    whole-buffer value, when ``tile_nbytes`` >= the buffer size).
+    Combine with ``crc_combine`` for the whole-blob value."""
+    dst_mv = memoryview(dst).cast("B")
+    src_mv = memoryview(src).cast("B")
+    if dst_mv.readonly:
+        raise ValueError("dst must be writable")
+    if dst_mv.nbytes != src_mv.nbytes:
+        raise ValueError(f"size mismatch: {dst_mv.nbytes} != {src_mv.nbytes}")
+    n = src_mv.nbytes
+    if n == 0:
+        return [crc32c(b"")]
+    if tile_nbytes <= 0 or tile_nbytes > n:
+        tile_nbytes = n
+    n_tiles = (n + tile_nbytes - 1) // tile_nbytes
+    lib = _load()
+    if lib is None:
+        out = []
+        for i in range(n_tiles):
+            sub = src_mv[i * tile_nbytes : min((i + 1) * tile_nbytes, n)]
+            out.append(crc32c(sub))
+            dst_mv[i * tile_nbytes : i * tile_nbytes + sub.nbytes] = sub
+        return out
+    crcs = (ctypes.c_uint32 * n_tiles)()
+    dst_ptr, dst_keep = _ptr(dst_mv)
+    src_ptr, src_keep = _ptr(src_mv)
+    lib.ts_memcpy_crc_tiles(dst_ptr, src_ptr, n, tile_nbytes, crcs, nthreads)
+    del dst_keep, src_keep
+    return list(crcs)
 
 
 def crc32c(buf, seed: int = 0) -> int:

@@ -850,6 +850,43 @@ int64_t ts_read_range_into_crc(const char* path, void* out, int64_t offset,
   return total;
 }
 
+// Fused clone + per-tile CRC32C: copies [src, src+n) to dst while
+// computing an independent (seed-0) CRC per ``tile`` bytes into
+// crcs[0..ceil(n/tile)). One memory pass instead of a hash pass plus a
+// copy pass — this is the async-snapshot staging hot path, where the
+// defensive clone and the integrity checksum would otherwise each read
+// every byte. Tiles are independent, so they parallelize across
+// nthreads; the caller derives the whole-blob CRC with
+// ts_crc32c_combine. n == 0 writes nothing (caller handles empties).
+void ts_memcpy_crc_tiles(void* dst, const void* src, size_t n, size_t tile,
+                         uint32_t* crcs, int nthreads) {
+  if (n == 0) return;
+  if (tile == 0 || tile > n) tile = n;
+  const size_t n_tiles = (n + tile - 1) / tile;
+  std::atomic<size_t> next{0};
+  auto work = [&] {
+    for (;;) {
+      const size_t i = next.fetch_add(1);
+      if (i >= n_tiles) return;
+      const size_t off = i * tile;
+      const size_t len = (n - off < tile) ? (n - off) : tile;
+      crcs[i] = ts_crccpy(static_cast<char*>(dst) + off,
+                          static_cast<const char*>(src) + off, len, 0, 1);
+    }
+  };
+  if (nthreads <= 1 || n_tiles == 1 || n < (8u << 20)) {
+    work();
+    return;
+  }
+  const int nt = (static_cast<size_t>(nthreads) < n_tiles)
+                     ? nthreads
+                     : static_cast<int>(n_tiles);
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (int t = 0; t < nt; ++t) threads.emplace_back(work);
+  for (auto& t : threads) t.join();
+}
+
 // Multi-threaded memcpy; nthreads <= 1 degrades to plain memcpy.
 void ts_memcpy_par(void* dst, const void* src, size_t n, int nthreads) {
   if (nthreads <= 1 || n < (8u << 20)) {

@@ -124,17 +124,46 @@ class ArrayBufferStager(BufferStager):
                 )
         host = np.asarray(arr)  # DtoH (no-op if DMA already done)
         mv = array_as_memoryview(host)
-        if self.entry is not None and not is_checksum_disabled():
-            _record_checksums(self.entry, mv)
+        want_crc = self.entry is not None and not is_checksum_disabled()
         if self.is_async_snapshot and _may_alias_live_memory(self.arr, host):
             # Defensive clone: training resumes before I/O completes, and a
             # donated buffer could be overwritten under us. The native
-            # memcpy releases the GIL (and parallelizes) for large clones.
+            # memcpy releases the GIL (and parallelizes) for large clones
+            # — and when checksums are on, the CRC is computed INSIDE the
+            # clone pass (one read per byte instead of two), since the
+            # clone is the async take's blocked time.
             from .. import _native
 
             out = _native.aligned_empty(mv.nbytes)
-            _native.memcpy(out, mv)
+            if want_crc:
+                tile_rows, row_nbytes = _tile_geometry(self.entry, mv.nbytes)
+                if tile_rows:
+                    crcs = _native.memcpy_crc_tiles(
+                        out, mv, tile_rows * row_nbytes
+                    )
+                    _annotate_checksums(
+                        self.entry, crcs, tile_rows, row_nbytes
+                    )
+                else:
+                    # Whole-blob checksum: still clone in internal
+                    # sub-tiles so the copy parallelizes (a (1, huge)
+                    # array maps to ONE checksum tile — without this the
+                    # fused pass would run single-threaded), then fold
+                    # the sub-tile values into the one recorded CRC.
+                    sub = 16 << 20
+                    crcs = _native.memcpy_crc_tiles(out, mv, sub)
+                    combined = crcs[0]
+                    for i, c in enumerate(crcs[1:], 1):
+                        ln = min((i + 1) * sub, mv.nbytes) - i * sub
+                        combined = _native.crc_combine(combined, c, ln)
+                    _annotate_checksums(
+                        self.entry, [combined], 0, row_nbytes
+                    )
+            else:
+                _native.memcpy(out, mv)
             return out
+        if want_crc:
+            _record_checksums(self.entry, mv)
         return mv
 
     def get_staging_cost_bytes(self) -> int:
@@ -186,6 +215,57 @@ def _want_crc(entry: TensorEntry) -> bool:
     return entry.checksum is not None and not is_checksum_disabled()
 
 
+def _tile_geometry(entry: TensorEntry, nbytes: int) -> Tuple[int, int]:
+    """(tile_rows, row_nbytes) for tile-grain checksums of this entry's
+    bytes, with tile_rows == 0 when the blob gets one whole-blob value.
+    Shared by the sync hash pass and the async fused clone+hash pass so
+    both record byte-identical manifests."""
+    from ..knobs import get_tile_checksum_bytes
+
+    shape = entry.shape
+    n_rows = shape[0] if shape else 0
+    row_nbytes = nbytes // n_rows if n_rows else 0
+    tile_rows = (
+        max(1, get_tile_checksum_bytes() // row_nbytes) if row_nbytes else 0
+    )
+    if n_rows > tile_rows >= 1:
+        return tile_rows, row_nbytes
+    return 0, row_nbytes
+
+
+def _annotate_checksums(
+    entry: TensorEntry,
+    tile_crcs: List[int],
+    tile_rows: int,
+    row_nbytes: int,
+) -> None:
+    """Record per-tile + combined whole-blob checksums into ``entry``
+    from raw seed-0 CRC values (one per tile, or a single whole-blob
+    value when ``tile_rows`` is 0)."""
+    from .. import _native
+
+    algo = _native.checksum_algorithm()
+    if tile_rows:
+        n_rows = entry.shape[0]
+        tiles: List[str] = []
+        combined: Optional[int] = None
+        for i, crc in enumerate(tile_crcs):
+            crc &= 0xFFFFFFFF
+            tiles.append(f"{algo}:{crc:08x}")
+            r1 = min((i + 1) * tile_rows, n_rows)
+            nb = (r1 - i * tile_rows) * row_nbytes
+            combined = (
+                crc
+                if combined is None
+                else _native.crc_combine(combined, crc, nb)
+            )
+        entry.tile_rows = tile_rows
+        entry.tile_checksums = tiles
+        entry.checksum = f"{algo}:{combined & 0xFFFFFFFF:08x}"
+    else:
+        entry.checksum = f"{algo}:{tile_crcs[0] & 0xFFFFFFFF:08x}"
+
+
 def _record_checksums(entry: TensorEntry, mv: memoryview) -> None:
     """Record integrity checksums into ``entry`` at stage time.
 
@@ -196,33 +276,19 @@ def _record_checksums(entry: TensorEntry, mv: memoryview) -> None:
     tiles' values (beyond the reference, which has no end-to-end
     integrity checking at all)."""
     from .. import _native
-    from ..knobs import get_tile_checksum_bytes
 
-    shape = entry.shape
-    n_rows = shape[0] if shape else 0
-    row_nbytes = mv.nbytes // n_rows if n_rows else 0
-    tile_rows = (
-        max(1, get_tile_checksum_bytes() // row_nbytes) if row_nbytes else 0
-    )
-    if n_rows > tile_rows >= 1:
-        algo = _native.checksum_algorithm()
-        tiles: List[str] = []
-        combined: Optional[int] = None
-        for r0 in range(0, n_rows, tile_rows):
-            r1 = min(r0 + tile_rows, n_rows)
-            sub = mv[r0 * row_nbytes : r1 * row_nbytes]
-            crc = _native.crc32c(sub) & 0xFFFFFFFF
-            tiles.append(f"{algo}:{crc:08x}")
-            combined = (
-                crc
-                if combined is None
-                else _native.crc_combine(combined, crc, sub.nbytes)
+    tile_rows, row_nbytes = _tile_geometry(entry, mv.nbytes)
+    if tile_rows:
+        n_rows = entry.shape[0]
+        crcs = [
+            _native.crc32c(
+                mv[r0 * row_nbytes : min(r0 + tile_rows, n_rows) * row_nbytes]
             )
-        entry.tile_rows = tile_rows
-        entry.tile_checksums = tiles
-        entry.checksum = f"{algo}:{combined & 0xFFFFFFFF:08x}"
+            for r0 in range(0, n_rows, tile_rows)
+        ]
     else:
-        entry.checksum = _native.checksum_string(mv)
+        crcs = [_native.crc32c(mv)]
+    _annotate_checksums(entry, crcs, tile_rows, row_nbytes)
 
 
 def combined_tile_checksum(
