@@ -66,13 +66,18 @@ def test_copy_to_host_async_overlaps_transfers():
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # let the real backend register
     env.pop("XLA_FLAGS", None)
-    proc = subprocess.run(
-        [sys.executable, "-c", _PROBE],
-        capture_output=True,
-        text=True,
-        timeout=280,
-        env=env,
-    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            capture_output=True,
+            text=True,
+            timeout=280,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        # The real-TPU tunnel can hang under contention; that's an
+        # environment condition, not an overlap regression.
+        pytest.skip("accelerator probe timed out (tunnel busy/unreachable)")
     if proc.returncode != 0:
         pytest.skip(f"accelerator probe failed: {proc.stderr[-500:]}")
     line = proc.stdout.strip().splitlines()[-1]

@@ -226,3 +226,38 @@ def test_cli_module_invocation(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert "0 corrupt" in proc.stdout
+
+
+def _world_take_for_scrub(snap_dir):
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict
+    from tpusnap.comm import get_communicator
+
+    comm = get_communicator()
+    # Rank-distinct per-rank state plus a replicated value.
+    state = StateDict(
+        local=np.full((64, 8), comm.rank, dtype=np.float32),
+        shared=np.arange(128, dtype=np.float32),
+    )
+    Snapshot.take(snap_dir, {"app": state}, replicated=["**/shared"])
+
+
+def test_multiprocess_snapshot_scrubs_clean_and_detects(tmp_path):
+    """A world-2 snapshot (per-rank + replicated entries) scrubs clean
+    from a single process; corruption in a rank-1 blob is detected and
+    attributed to the '1/...' manifest path."""
+    from tpusnap.test_utils import run_subprocess_world
+
+    path = str(tmp_path / "snap")
+    run_subprocess_world(_world_take_for_scrub, world_size=2, args=[path])
+    report = verify_snapshot(path)
+    assert report.clean
+    md = Snapshot(path).metadata
+    assert md.world_size == 2
+    assert "1/app/local" in md.manifest  # rank-1 entries present
+
+    _flip_byte(path, "1/app/local")
+    report = verify_snapshot(path)
+    assert not report.clean
+    assert any(f.manifest_path.startswith("1/") for f in report.failures)

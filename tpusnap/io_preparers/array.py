@@ -152,10 +152,9 @@ class ArrayBufferStager(BufferStager):
                     # the sub-tile values into the one recorded CRC.
                     sub = 16 << 20
                     crcs = _native.memcpy_crc_tiles(out, mv, sub)
-                    combined = crcs[0]
-                    for i, c in enumerate(crcs[1:], 1):
-                        ln = min((i + 1) * sub, mv.nbytes) - i * sub
-                        combined = _native.crc_combine(combined, c, ln)
+                    combined = _fold_crcs(
+                        crcs, _tile_lengths(mv.nbytes, sub, len(crcs))
+                    )
                     _annotate_checksums(
                         self.entry, [combined], 0, row_nbytes
                     )
@@ -215,6 +214,27 @@ def _want_crc(entry: TensorEntry) -> bool:
     return entry.checksum is not None and not is_checksum_disabled()
 
 
+def _tile_lengths(nbytes: int, tile_nbytes: int, n_tiles: int) -> List[int]:
+    """Byte length of each of ``n_tiles`` consecutive tiles of
+    ``tile_nbytes`` covering ``nbytes`` (last tile short)."""
+    return [
+        min((i + 1) * tile_nbytes, nbytes) - i * tile_nbytes
+        for i in range(n_tiles)
+    ]
+
+
+def _fold_crcs(crcs: List[int], lengths: List[int]) -> int:
+    """Combine per-tile seed-0 CRC values (with their byte lengths) into
+    the CRC of the concatenation — the ONE fold used by every writer and
+    verifier, so their boundary math cannot drift apart."""
+    from .. import _native
+
+    combined = crcs[0] & 0xFFFFFFFF
+    for c, ln in zip(crcs[1:], lengths[1:]):
+        combined = _native.crc_combine(combined, c & 0xFFFFFFFF, ln)
+    return combined & 0xFFFFFFFF
+
+
 def _tile_geometry(entry: TensorEntry, nbytes: int) -> Tuple[int, int]:
     """(tile_rows, row_nbytes) for tile-grain checksums of this entry's
     bytes, with tile_rows == 0 when the blob gets one whole-blob value.
@@ -247,21 +267,17 @@ def _annotate_checksums(
     algo = _native.checksum_algorithm()
     if tile_rows:
         n_rows = entry.shape[0]
-        tiles: List[str] = []
-        combined: Optional[int] = None
-        for i, crc in enumerate(tile_crcs):
-            crc &= 0xFFFFFFFF
-            tiles.append(f"{algo}:{crc:08x}")
-            r1 = min((i + 1) * tile_rows, n_rows)
-            nb = (r1 - i * tile_rows) * row_nbytes
-            combined = (
-                crc
-                if combined is None
-                else _native.crc_combine(combined, crc, nb)
-            )
+        combined = _fold_crcs(
+            tile_crcs,
+            _tile_lengths(
+                n_rows * row_nbytes, tile_rows * row_nbytes, len(tile_crcs)
+            ),
+        )
         entry.tile_rows = tile_rows
-        entry.tile_checksums = tiles
-        entry.checksum = f"{algo}:{combined & 0xFFFFFFFF:08x}"
+        entry.tile_checksums = [
+            f"{algo}:{crc & 0xFFFFFFFF:08x}" for crc in tile_crcs
+        ]
+        entry.checksum = f"{algo}:{combined:08x}"
     else:
         entry.checksum = f"{algo}:{tile_crcs[0] & 0xFFFFFFFF:08x}"
 
@@ -308,24 +324,22 @@ def combined_tile_checksum(
     if r0 % t != 0 or (r1 != n_rows and r1 % t != 0):
         return None
     algo = _native.checksum_algorithm()
-    combined: Optional[int] = None
+    crcs: List[int] = []
+    lengths: List[int] = []
     for i in range(r0 // t, math.ceil(r1 / t)):
         tile = entry.tile_checksums[i]
         tile_algo, _, value = tile.partition(":")
         if tile_algo != algo:
             return None
         try:
-            crc = int(value, 16)
+            crcs.append(int(value, 16))
         except ValueError:
             return None
         tr1 = min((i + 1) * t, n_rows)
-        nb = (tr1 - i * t) * row_nbytes
-        combined = (
-            crc if combined is None else _native.crc_combine(combined, crc, nb)
-        )
-    if combined is None:
+        lengths.append((tr1 - i * t) * row_nbytes)
+    if not crcs:
         return None
-    return f"{algo}:{combined & 0xFFFFFFFF:08x}"
+    return f"{algo}:{_fold_crcs(crcs, lengths):08x}"
 
 
 class ArrayBufferConsumer(BufferConsumer):
