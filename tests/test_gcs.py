@@ -439,3 +439,32 @@ def test_scrub_verifies_and_detects_through_gcs(fake_gcs, monkeypatch):
     report = verify_snapshot("gs://bkt/snaps/scrub", storage_options=opts)
     assert not report.clean
     assert report.corrupt >= 1
+
+
+def test_incremental_snapshot_through_gcs(fake_gcs, monkeypatch):
+    """Cross-snapshot '../base/...' references resolve through the gs://
+    key namespace (client-side normpath in _object_name)."""
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict, verify_snapshot
+    from tpusnap.knobs import override_batching_disabled
+
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", fake_gcs.endpoint)
+    opts = {"api_endpoint": fake_gcs.endpoint, "deadline_sec": 30.0}
+    state = StateDict(w=np.arange(8192, dtype=np.float32), step=1)
+    with override_batching_disabled(True):
+        Snapshot.take("gs://bkt/snaps/s0", {"s": state})
+        n_before = len(fake_gcs.objects)
+        Snapshot.take(
+            "gs://bkt/snaps/s1",
+            {"s": state},
+            incremental_from="gs://bkt/snaps/s0",
+        )
+    # Only s1's metadata was uploaded; w deduped against s0's blob.
+    new = {k for k in fake_gcs.objects if "snaps/s1" in k}
+    assert new == {"snaps/s1/.snapshot_metadata"}, new
+    assert len(fake_gcs.objects) == n_before + 1
+    target = StateDict(w=np.zeros(8192, dtype=np.float32), step=0)
+    Snapshot("gs://bkt/snaps/s1", storage_options=opts).restore({"s": target})
+    assert np.array_equal(target["w"], state["w"]) and target["step"] == 1
+    assert verify_snapshot("gs://bkt/snaps/s1", storage_options=opts).clean

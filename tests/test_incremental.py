@@ -1,0 +1,328 @@
+"""Incremental snapshots: ``Snapshot.take(..., incremental_from=prev)``
+skips writing blobs whose stage-time checksums match the base snapshot and
+references the base's blobs by relative location (no reference
+counterpart — torchsnapshot rewrites every byte every take).
+
+Covers: unchanged state writes no data blobs; a changed leaf rewrites
+only itself; restore/read_object/scrub resolve cross-snapshot references;
+chained increments collapse to the oldest base; sharded/chunked/object
+dedup; async incremental takes; deleting the base breaks the increment
+loudly; slab-batched members always rewrite (no slab holes).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusnap import PytreeState, Snapshot, StateDict, verify_snapshot
+from tpusnap.knobs import (
+    override_batching_disabled,
+    override_max_chunk_size_bytes,
+    override_max_shard_size_bytes,
+    override_tile_checksum_bytes,
+)
+
+
+def _blob_files(root: str):
+    """All files under a snapshot dir except the metadata."""
+    out = []
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            if f != ".snapshot_metadata":
+                out.append(os.path.relpath(os.path.join(dirpath, f), root))
+    return sorted(out)
+
+
+def _state(seed=0):
+    rng = np.random.default_rng(seed)
+    return StateDict(
+        w=rng.standard_normal((512, 128)).astype(np.float32),
+        b=rng.standard_normal((256,)).astype(np.float32),
+        cfg={"lr": 0.1, "layers": [1, 2]},
+        step=1,
+    )
+
+
+def test_unchanged_take_writes_no_data(tmp_path):
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    with override_batching_disabled(True):
+        Snapshot.take(base, {"app": _state()})
+        Snapshot.take(inc, {"app": _state()}, incremental_from=base)
+    assert _blob_files(inc) == [], "unchanged take must write no data blobs"
+    # Restore from the increment resolves into the base's blobs.
+    target = {"app": StateDict(w=np.zeros((512, 128), np.float32),
+                               b=np.zeros((256,), np.float32),
+                               cfg={}, step=0)}
+    Snapshot(inc).restore(target)
+    src = _state()
+    assert np.array_equal(target["app"]["w"], src["w"])
+    assert np.array_equal(target["app"]["b"], src["b"])
+    assert target["app"]["cfg"] == {"lr": 0.1, "layers": [1, 2]}
+    assert target["app"]["step"] == 1
+    # Scrub follows cross-snapshot references.
+    assert verify_snapshot(inc).clean
+    # read_object too.
+    out = Snapshot(inc).read_object("0/app/w")
+    assert np.array_equal(out, src["w"])
+
+
+def test_changed_leaf_rewrites_only_itself(tmp_path):
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    st = _state()
+    with override_batching_disabled(True):
+        Snapshot.take(base, {"app": st})
+        st["b"] = st["b"] + 1.0
+        Snapshot.take(inc, {"app": st}, incremental_from=base)
+    files = _blob_files(inc)
+    assert files == ["0/app/b"], files
+    target = {"app": StateDict(w=np.zeros((512, 128), np.float32),
+                               b=np.zeros((256,), np.float32),
+                               cfg={}, step=0)}
+    Snapshot(inc).restore(target)
+    assert np.array_equal(target["app"]["b"], st["b"])
+    assert np.array_equal(target["app"]["w"], st["w"])
+
+
+def test_chained_increments_collapse_to_oldest_base(tmp_path):
+    s0, s1, s2 = (str(tmp_path / f"s{i}") for i in range(3))
+    st = _state()
+    with override_batching_disabled(True):
+        Snapshot.take(s0, {"app": st})
+        st["b"] = st["b"] * 2
+        Snapshot.take(s1, {"app": st}, incremental_from=s0)
+        Snapshot.take(s2, {"app": st}, incremental_from=s1)
+    assert _blob_files(s2) == []
+    # s2's unchanged-since-s0 entries must point STRAIGHT at s0 (chains
+    # collapse; lookups never hop through s1).
+    md = Snapshot(s2).metadata
+    w_loc = md.manifest["0/app/w"].location
+    assert w_loc == "../s0/0/app/w", w_loc
+    b_loc = md.manifest["0/app/b"].location
+    assert b_loc == "../s1/0/app/b", b_loc
+    assert verify_snapshot(s2).clean
+    target = {"app": StateDict(w=np.zeros((512, 128), np.float32),
+                               b=np.zeros((256,), np.float32),
+                               cfg={}, step=0)}
+    Snapshot(s2).restore(target)
+    assert np.array_equal(target["app"]["b"], st["b"])
+
+
+def test_sharded_incremental(tmp_path):
+    mesh = jax.sharding.Mesh(np.array(jax.devices()).reshape(4, 2), ("x", "y"))
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x", "y"))
+    w = jax.device_put(
+        jnp.arange(64 * 64, dtype=jnp.float32).reshape(64, 64), sh
+    )
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    Snapshot.take(base, {"m": PytreeState({"w": w})})
+    Snapshot.take(inc, {"m": PytreeState({"w": w})}, incremental_from=base)
+    assert _blob_files(inc) == []
+    assert verify_snapshot(inc).clean
+    # Change the array: all shards rewrite.
+    w2 = jax.device_put(w + 1, sh)
+    inc2 = str(tmp_path / "s2")
+    Snapshot.take(inc2, {"m": PytreeState({"w": w2})}, incremental_from=inc)
+    assert len(_blob_files(inc2)) == 8  # one blob per shard
+    target = {"m": PytreeState({"w": jax.device_put(jnp.zeros((64, 64), jnp.float32), sh)})}
+    Snapshot(inc2).restore(target)
+    assert np.array_equal(np.asarray(target["m"].tree["w"]), np.asarray(w2))
+
+
+def test_chunked_incremental_partial_change(tmp_path):
+    """Only the chunks whose rows changed rewrite."""
+    arr = np.random.default_rng(3).standard_normal((64, 256)).astype(np.float32)
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    with override_max_chunk_size_bytes(16 * 1024), override_batching_disabled(True):
+        Snapshot.take(base, {"app": StateDict(big=arr)})
+        arr2 = arr.copy()
+        arr2[-1, :] += 1.0  # touch only the last chunk's rows
+        Snapshot.take(inc, {"app": StateDict(big=arr2)}, incremental_from=base)
+    files = _blob_files(inc)
+    assert len(files) == 1 and files[0].startswith("0/app/big_"), files
+    target = {"app": StateDict(big=np.zeros_like(arr))}
+    Snapshot(inc).restore(target)
+    assert np.array_equal(target["app"]["big"], arr2)
+    assert verify_snapshot(inc).clean
+
+
+def test_async_incremental_take(tmp_path):
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    st = _state()
+    with override_batching_disabled(True):
+        Snapshot.take(base, {"app": st})
+        pending = Snapshot.async_take(
+            inc, {"app": st}, incremental_from=base
+        )
+        snap = pending.wait()
+    assert _blob_files(inc) == []
+    assert snap.verify().clean
+
+
+def test_deleted_base_breaks_increment_loudly(tmp_path):
+    import shutil
+
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    with override_batching_disabled(True):
+        Snapshot.take(base, {"app": _state()})
+        Snapshot.take(inc, {"app": _state()}, incremental_from=base)
+    shutil.rmtree(base)
+    report = verify_snapshot(inc)
+    assert not report.clean and report.corrupt > 0
+    target = {"app": StateDict(w=np.zeros((512, 128), np.float32),
+                               b=np.zeros((256,), np.float32),
+                               cfg={}, step=0)}
+    with pytest.raises(Exception):
+        Snapshot(inc).restore(target)
+
+
+def test_slab_members_always_rewrite(tmp_path):
+    """Batched small arrays stage into slabs; dedup must not hole them."""
+    st = StateDict(
+        a=np.arange(64, dtype=np.float32),
+        b=np.arange(64, 128, dtype=np.float32),
+        c=np.arange(128, 192, dtype=np.float32),
+    )
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    Snapshot.take(base, {"app": st})
+    Snapshot.take(inc, {"app": st}, incremental_from=base)
+    # Whether or not members deduped, the increment restores bit-exact
+    # and scrubs clean (slab integrity preserved).
+    target = {"app": StateDict(a=np.zeros(64, np.float32),
+                               b=np.zeros(64, np.float32),
+                               c=np.zeros(64, np.float32))}
+    Snapshot(inc).restore(target)
+    for k in ("a", "b", "c"):
+        assert np.array_equal(target["app"][k], st[k]), k
+    assert verify_snapshot(inc).clean
+
+
+def test_incremental_tile_grain(tmp_path):
+    """Large blobs keep tile checksums through dedup; budget-tiled reads
+    of a deduped entry verify against the base's bytes."""
+    arr = np.random.default_rng(5).standard_normal((4096, 64)).astype(np.float32)
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    with override_tile_checksum_bytes(128 * 1024), override_batching_disabled(True):
+        Snapshot.take(base, {"app": StateDict(big=arr)})
+        Snapshot.take(inc, {"app": StateDict(big=arr)}, incremental_from=base)
+    assert _blob_files(inc) == []
+    e = Snapshot(inc).metadata.manifest["0/app/big"]
+    assert e.tile_checksums and len(e.tile_checksums) > 1
+    out = Snapshot(inc).read_object("0/app/big", memory_budget_bytes=256 * 1024)
+    assert np.array_equal(out, arr)
+
+
+def test_incremental_requires_same_scheme(tmp_path):
+    with pytest.raises(ValueError, match="scheme"):
+        Snapshot.take(
+            str(tmp_path / "s1"),
+            {"app": StateDict(x=np.ones(4, np.float32))},
+            incremental_from="gs://bkt/other",
+        )
+
+
+def test_incremental_from_missing_base_fails(tmp_path):
+    with pytest.raises(RuntimeError, match="not a readable snapshot"):
+        Snapshot.take(
+            str(tmp_path / "s1"),
+            {"app": StateDict(x=np.ones(4, np.float32))},
+            incremental_from=str(tmp_path / "nope"),
+        )
+
+
+def test_sharded_subdivided_incremental(tmp_path):
+    """Shards subdivided to the max-shard knob dedup per sub-shard box."""
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("x",))
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x"))
+    w = jax.device_put(jnp.arange(1024 * 8, dtype=jnp.float32).reshape(1024, 8), sh)
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    with override_max_shard_size_bytes(8 * 1024):
+        Snapshot.take(base, {"m": PytreeState({"w": w})})
+        Snapshot.take(inc, {"m": PytreeState({"w": w})}, incremental_from=base)
+    assert _blob_files(inc) == []
+    assert verify_snapshot(inc).clean
+
+
+def test_cli_info_reports_external_refs(tmp_path, capsys):
+    from tpusnap.__main__ import main as cli_main
+
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    with override_batching_disabled(True):
+        Snapshot.take(base, {"app": _state()})
+        Snapshot.take(inc, {"app": _state()}, incremental_from=base)
+    assert cli_main(["info", inc]) == 0
+    out = capsys.readouterr().out
+    assert "external:" in out and "s0" in out
+    assert cli_main(["info", base]) == 0
+    assert "external:" not in capsys.readouterr().out
+
+
+def _world_incremental_replicated(base, inc):
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict
+
+    def state():
+        return StateDict(
+            w1=np.arange(512 * 64, dtype=np.float32).reshape(512, 64),
+            w2=np.arange(512 * 64, dtype=np.float32).reshape(512, 64) * 2,
+        )
+
+    # Both arrays replicated; the write-load partitioner assigns them to
+    # different ranks, so at least one writer is rank != 0.
+    Snapshot.take(base, {"model": state()}, replicated=["**"])
+    Snapshot.take(
+        inc, {"model": state()}, replicated=["**"], incremental_from=base
+    )
+    # Second increment with one value changed: only that blob rewrites.
+    st = state()
+    st["w2"] = st["w2"] + 1.0
+    Snapshot.take(
+        inc + "_b", {"model": st}, replicated=["**"], incremental_from=inc
+    )
+
+
+def test_multirank_replicated_incremental(tmp_path):
+    """A replicated entry deduped by its assigned writer rank (possibly
+    rank != 0) must survive manifest consolidation: the committed
+    manifest references the base blob, restores, and scrubs clean.
+    (Consolidation must prefer the writer's rewritten copy over rank 0's
+    never-staged one.)"""
+    from tpusnap.test_utils import run_subprocess_world
+
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    run_subprocess_world(
+        _world_incremental_replicated,
+        world_size=2,
+        args=[base, inc],
+        extra_env={"TPUSNAP_DISABLE_BATCHING": "1"},
+    )
+    assert _blob_files(inc) == [], _blob_files(inc)
+    md = Snapshot(inc).metadata
+    for p in ("0/model/w1", "0/model/w2"):
+        assert md.manifest[p].location.startswith("../"), (
+            p,
+            md.manifest[p].location,
+        )
+        assert md.manifest[p].checksum is not None
+    target = {"model": StateDict(
+        w1=np.zeros((512, 64), np.float32), w2=np.zeros((512, 64), np.float32)
+    )}
+    Snapshot(inc).restore(target)
+    expect = np.arange(512 * 64, dtype=np.float32).reshape(512, 64)
+    assert np.array_equal(target["model"]["w1"], expect)
+    assert np.array_equal(target["model"]["w2"], expect * 2)
+    assert verify_snapshot(inc).clean
+
+    # The chained increment rewrote only the changed replicated blob.
+    inc_b = inc + "_b"
+    files = _blob_files(inc_b)
+    assert files == ["replicated/model/w2"], files
+    assert verify_snapshot(inc_b).clean
+    tgt2 = {"model": StateDict(
+        w1=np.zeros((512, 64), np.float32), w2=np.zeros((512, 64), np.float32)
+    )}
+    Snapshot(inc_b).restore(tgt2)
+    assert np.array_equal(tgt2["model"]["w2"], expect * 2 + 1.0)

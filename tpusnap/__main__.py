@@ -54,6 +54,8 @@ def _entry_desc(entry) -> str:
 
 
 def cmd_info(args) -> int:
+    from .inspect import iter_blobs
+
     md = Snapshot(args.path).metadata
     counts: dict = {}
     total = 0
@@ -62,6 +64,7 @@ def cmd_info(args) -> int:
             continue
         counts[e.type] = counts.get(e.type, 0) + 1
         total += entry_nbytes(e)
+    external = [b for b in iter_blobs(md.manifest) if b.location.startswith("../")]
     print(f"path:        {args.path}")
     print(f"version:     {md.version}")
     print(f"world_size:  {md.world_size}")
@@ -69,7 +72,25 @@ def cmd_info(args) -> int:
     print(f"entries:     {sum(counts.values())}")
     for t, c in sorted(counts.items()):
         print(f"  {t:14s} {c}")
+    if external:
+        bases = sorted({_base_root(b.location) for b in external})
+        print(
+            f"external:    {len(external)} blob range(s) reference base "
+            f"snapshot(s): {', '.join(bases)} — keep them alive"
+        )
     return 0
+
+
+def _base_root(location: str) -> str:
+    """Base-snapshot root (relative to this snapshot) of an external blob
+    location: everything before the storage-layout segment (``<rank>/``,
+    ``replicated/``, ``sharded/``, ``batched/``) that starts the blob's
+    path within its snapshot."""
+    segs = location.split("/")
+    for i, s in enumerate(segs):
+        if s.isdigit() or s in ("replicated", "sharded", "batched"):
+            return "/".join(segs[:i]) or location
+    return location
 
 
 def cmd_ls(args) -> int:

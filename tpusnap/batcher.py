@@ -262,11 +262,17 @@ def batch_write_requests(
             )
         else:
             location = f"batched/{uuid.uuid4().hex}"
-            for (member_offset, nbytes, _), tensor_entry in zip(
+            for (member_offset, nbytes, stager), tensor_entry in zip(
                 slab_members, slab_entries
             ):
                 tensor_entry.location = location
                 tensor_entry.byte_range = [member_offset, member_offset + nbytes]
+                # Slab members stage INTO the slab; a member skipping its
+                # write (incremental dedup) would hole the slab, so
+                # members always rewrite. (Blobs above the slab threshold
+                # and all shards/chunks never batch and dedup normally.)
+                if hasattr(stager, "dedup_entry"):
+                    stager.dedup_entry = None
             stager_cls = (
                 DeviceBatchedBufferStager
                 if slab_device is not None

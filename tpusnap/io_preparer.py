@@ -54,6 +54,7 @@ def prepare_write(
     is_async_snapshot: bool = False,
     array_prepare_func: Optional[Any] = None,
     array_prepare_traced: Optional[Tuple[str, Any]] = None,
+    prev_entry: Optional[Entry] = None,
 ) -> Tuple[Entry, List[WriteReq]]:
     """``array_prepare_func(arr, tracing) -> arr`` is the user save-time
     transform (reference _custom_tensor_prepare_func, snapshot.py:
@@ -61,7 +62,11 @@ def prepare_write(
     and non-array objects pass through untransformed.
     ``array_prepare_traced`` is the already-traced (dtype, shape) from
     the write-load estimator, so untraceable transforms don't execute a
-    second discarded time here."""
+    second discarded time here.
+    ``prev_entry`` is the previous snapshot's entry for this logical path
+    (locations rewritten relative to the new snapshot root) for
+    incremental-snapshot dedup: blobs whose staged bytes hash identically
+    skip their writes and reference the previous snapshot's blob."""
     if PrimitiveEntry.supported(obj):
         return PrimitiveEntry.from_object(obj, replicated=replicated), []
 
@@ -71,7 +76,10 @@ def prepare_write(
     if isinstance(obj, jax.Array) and is_sharded(obj):
         storage_path = get_storage_path(logical_path, rank, False, sharded=True)
         return ShardedArrayIOPreparer.prepare_write(
-            storage_path, obj, is_async_snapshot=is_async_snapshot
+            storage_path,
+            obj,
+            is_async_snapshot=is_async_snapshot,
+            prev_entry=prev_entry,
         )
 
     if isinstance(obj, (jax.Array, np.ndarray)) and is_supported_array_dtype(obj):
@@ -84,6 +92,7 @@ def prepare_write(
                 is_async_snapshot,
                 array_prepare_func=array_prepare_func,
                 array_prepare_traced=array_prepare_traced,
+                prev_entry=prev_entry,
             )
         return ArrayIOPreparer.prepare_write(
             storage_path,
@@ -92,10 +101,13 @@ def prepare_write(
             is_async_snapshot,
             array_prepare_func=array_prepare_func,
             array_prepare_traced=array_prepare_traced,
+            prev_entry=prev_entry,
         )
 
     storage_path = get_storage_path(logical_path, rank, replicated, sharded=False)
-    return ObjectIOPreparer.prepare_write(storage_path, obj, replicated)
+    return ObjectIOPreparer.prepare_write(
+        storage_path, obj, replicated, prev_entry=prev_entry
+    )
 
 
 def prepare_read(

@@ -83,6 +83,7 @@ class ArrayBufferStager(BufferStager):
         is_async_snapshot: bool = False,
         entry: Optional[TensorEntry] = None,
         array_prepare_func: Optional[Callable[[ArrayLike, bool], ArrayLike]] = None,
+        dedup_entry: Optional[TensorEntry] = None,
     ) -> None:
         self.arr = arr
         self.is_async_snapshot = is_async_snapshot
@@ -90,6 +91,11 @@ class ArrayBufferStager(BufferStager):
         # manifest is gathered after staging completes, so the value lands
         # in the committed metadata.
         self.entry = entry
+        # Incremental snapshots: the previous snapshot's entry for this
+        # blob, locations already rewritten relative to the NEW snapshot
+        # root. If the staged bytes hash to the same checksums, the write
+        # is skipped and ``entry`` adopts the previous blob's location.
+        self.dedup_entry = dedup_entry
         # User save-time transform (dtype cast / quantize-on-save),
         # applied to the ORIGINAL array at stage time with tracing=False
         # (reference io_preparers/tensor.py:231-241).
@@ -125,6 +131,29 @@ class ArrayBufferStager(BufferStager):
         host = np.asarray(arr)  # DtoH (no-op if DMA already done)
         mv = array_as_memoryview(host)
         want_crc = self.entry is not None and not is_checksum_disabled()
+        if want_crc and self.dedup_entry is not None:
+            # Incremental dedup: hash first (the expected outcome is
+            # "unchanged", where no clone and no write happen at all).
+            from ..io_types import SKIP_WRITE
+
+            _record_checksums(self.entry, mv)
+            if dedup_entries_match(self.entry, self.dedup_entry):
+                self.entry.location = self.dedup_entry.location
+                self.entry.byte_range = (
+                    list(self.dedup_entry.byte_range)
+                    if self.dedup_entry.byte_range is not None
+                    else None
+                )
+                return SKIP_WRITE
+            if self.is_async_snapshot and _may_alias_live_memory(
+                self.arr, host
+            ):
+                from .. import _native
+
+                out = _native.aligned_empty(mv.nbytes)
+                _native.memcpy(out, mv)  # checksums already recorded
+                return out
+            return mv
         if self.is_async_snapshot and _may_alias_live_memory(self.arr, host):
             # Defensive clone: training resumes before I/O completes, and a
             # donated buffer could be overwritten under us. The native
@@ -212,6 +241,23 @@ def _want_crc(entry: TensorEntry) -> bool:
     from ..knobs import is_checksum_disabled
 
     return entry.checksum is not None and not is_checksum_disabled()
+
+
+def dedup_entries_match(new: TensorEntry, prev: TensorEntry) -> bool:
+    """True when the freshly staged blob (``new``, checksums recorded) is
+    byte-identical to the previous snapshot's blob per its recorded
+    checksums — same dtype/shape/serializer, same whole-blob CRC, and the
+    same tile-grain CRCs (a changed tile-size knob between takes makes
+    geometries differ and conservatively fails the match)."""
+    return (
+        prev.checksum is not None
+        and new.checksum == prev.checksum
+        and new.dtype == prev.dtype
+        and list(new.shape) == list(prev.shape)
+        and new.serializer == prev.serializer
+        and new.tile_rows == prev.tile_rows
+        and new.tile_checksums == prev.tile_checksums
+    )
 
 
 def _tile_lengths(nbytes: int, tile_nbytes: int, n_tiles: int) -> List[int]:
@@ -493,6 +539,7 @@ class ArrayIOPreparer:
         is_async_snapshot: bool = False,
         array_prepare_func: Optional[Callable[[ArrayLike, bool], ArrayLike]] = None,
         array_prepare_traced: Optional[Tuple[str, List[int]]] = None,
+        prev_entry: Optional[object] = None,
     ) -> Tuple[TensorEntry, List[WriteReq]]:
         if array_prepare_traced is not None:
             dtype, shape = array_prepare_traced[0], list(array_prepare_traced[1])
@@ -513,6 +560,11 @@ class ArrayIOPreparer:
                     is_async_snapshot,
                     entry=entry,
                     array_prepare_func=array_prepare_func,
+                    dedup_entry=(
+                        prev_entry
+                        if isinstance(prev_entry, TensorEntry)
+                        else None
+                    ),
                 ),
             )
         ]

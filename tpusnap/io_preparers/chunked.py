@@ -56,6 +56,7 @@ class ChunkedArrayIOPreparer:
         is_async_snapshot: bool = False,
         array_prepare_func=None,
         array_prepare_traced=None,
+        prev_entry=None,
     ) -> Tuple[ChunkedTensorEntry, List[WriteReq]]:
         from .array import trace_array_prepare
 
@@ -66,6 +67,15 @@ class ChunkedArrayIOPreparer:
             dtype, shape = array_prepare_traced[0], list(array_prepare_traced[1])
         else:
             dtype, shape = trace_array_prepare(arr, array_prepare_func)
+        # Incremental dedup: match chunks of the previous snapshot's entry
+        # by (offsets, sizes) — a changed chunk-size knob between takes
+        # shifts boundaries and conservatively misses.
+        prev_chunks = {}
+        if isinstance(prev_entry, ChunkedTensorEntry):
+            prev_chunks = {
+                (tuple(c.offsets), tuple(c.sizes)): c.tensor
+                for c in prev_entry.chunks
+            }
         ranges = chunk_row_ranges(shape, dtype, get_max_chunk_size_bytes())
         chunks: List[Chunk] = []
         write_reqs: List[WriteReq] = []
@@ -74,6 +84,8 @@ class ChunkedArrayIOPreparer:
             # Lazy device-side slice; DtoH happens at staging time.
             sub = arr[r0:r1]
             location = f"{storage_path}_{r0}_0"
+            offsets = [r0] + [0] * (ndim - 1)
+            sizes = [r1 - r0] + shape[1:]
             tensor_entry = TensorEntry(
                 location=location,
                 serializer=Serializer.BUFFER_PROTOCOL.value,
@@ -82,11 +94,7 @@ class ChunkedArrayIOPreparer:
                 replicated=replicated,
             )
             chunks.append(
-                Chunk(
-                    offsets=[r0] + [0] * (ndim - 1),
-                    sizes=[r1 - r0] + shape[1:],
-                    tensor=tensor_entry,
-                )
+                Chunk(offsets=offsets, sizes=sizes, tensor=tensor_entry)
             )
             write_reqs.append(
                 WriteReq(
@@ -96,6 +104,9 @@ class ChunkedArrayIOPreparer:
                         is_async_snapshot,
                         entry=tensor_entry,
                         array_prepare_func=array_prepare_func,
+                        dedup_entry=prev_chunks.get(
+                            (tuple(offsets), tuple(sizes))
+                        ),
                     ),
                 )
             )

@@ -72,7 +72,10 @@ class ObjectBufferConsumer(BufferConsumer):
 class ObjectIOPreparer:
     @staticmethod
     def prepare_write(
-        storage_path: str, obj: Any, replicated: bool = False
+        storage_path: str,
+        obj: Any,
+        replicated: bool = False,
+        prev_entry: Any = None,
     ) -> Tuple[ObjectEntry, List[WriteReq]]:
         buf = pickle_as_bytes(obj)
         from ..knobs import is_checksum_disabled
@@ -90,6 +93,17 @@ class ObjectIOPreparer:
             nbytes=len(buf),
             checksum=checksum,
         )
+        # Incremental dedup: objects pickle + hash eagerly at prepare
+        # time, so an unchanged object needs no write request at all.
+        if (
+            isinstance(prev_entry, ObjectEntry)
+            and checksum is not None
+            and prev_entry.checksum == checksum
+            and prev_entry.nbytes == len(buf)
+            and prev_entry.serializer == entry.serializer
+        ):
+            entry.location = prev_entry.location
+            return entry, []
         return entry, [WriteReq(path=storage_path, buffer_stager=ObjectBufferStager(buf))]
 
     @staticmethod

@@ -116,11 +116,22 @@ class ShardedArrayIOPreparer:
         storage_path: str,
         arr: jax.Array,
         is_async_snapshot: bool = False,
+        prev_entry=None,
     ) -> Tuple[ShardedEntry, List[WriteReq]]:
         dtype_str = dtype_to_string(arr.dtype)
         itemsize = string_to_dtype(dtype_str).itemsize
         max_bytes = get_max_shard_size_bytes()
         global_shape = list(arr.shape)
+
+        # Incremental dedup: the previous snapshot's (merged, all-rank)
+        # entry's shards keyed by box — a resharded array's boxes differ
+        # and conservatively miss.
+        prev_shards = {}
+        if isinstance(prev_entry, ShardedEntry):
+            prev_shards = {
+                (tuple(s.offsets), tuple(s.sizes)): s.tensor
+                for s in prev_entry.shards
+            }
 
         shards_meta: List[ShardMeta] = []
         write_reqs: List[WriteReq] = []
@@ -152,7 +163,12 @@ class ShardedArrayIOPreparer:
                     WriteReq(
                         path=loc,
                         buffer_stager=ArrayBufferStager(
-                            data, is_async_snapshot, entry=tensor_entry
+                            data,
+                            is_async_snapshot,
+                            entry=tensor_entry,
+                            dedup_entry=prev_shards.get(
+                                (tuple(sub_off), tuple(sub_sz))
+                            ),
                         ),
                     )
                 )

@@ -63,10 +63,27 @@ class ReadIO:
     crc_algo: Optional[str] = None
 
 
+class _SkipWrite:
+    """Sentinel a stager may return instead of bytes: the blob's content
+    is already persisted (incremental snapshot dedup — the stager
+    rewrote its entry to reference the previous snapshot's blob), so the
+    pipeline completes this request without any storage I/O."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "SKIP_WRITE"
+
+
+SKIP_WRITE = _SkipWrite()
+
+
 class BufferStager(abc.ABC):
     @abc.abstractmethod
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
-        """Produce the bytes to persist (may run DtoH copies in ``executor``)."""
+        """Produce the bytes to persist (may run DtoH copies in
+        ``executor``), or ``SKIP_WRITE`` when the content is already
+        persisted and this request needs no storage I/O."""
 
     @abc.abstractmethod
     def get_staging_cost_bytes(self) -> int:

@@ -241,13 +241,23 @@ def consolidate_replicated_entries(
     reflect slab batching) — reference partitioner.py:236-303.
 
     The writer's version is recognized without carrying the assignment
-    around: exactly one rank's copy of a replicated entry was rewritten
-    by its batcher (location under ``batched/``) or, if unbatched, all
-    copies are identical so any works. Chunked entries merge per-chunk
-    the same way.
+    around: only the writer's copy was rewritten by its batcher (location
+    under ``batched/``), rewritten by incremental dedup (location under
+    ``../``), or staged at all (stage-time ``checksum`` recorded — the
+    non-writers' copies never stage). If nothing marks a writer
+    (checksums disabled, unbatched, non-incremental), all copies are
+    identical and any works. Chunked entries merge per-chunk the same
+    way (the partitioner assigns chunks of one entry to different
+    writer ranks).
     """
     global_manifest: Manifest = {}
     world_size = len(per_rank_entries)
+
+    def writer_marked(t) -> bool:
+        return (
+            getattr(t, "location", "").startswith(("batched/", "../"))
+            or getattr(t, "checksum", None) is not None
+        )
 
     # Pass 1: find the authoritative version of each replicated path.
     authoritative: Dict[str, Entry] = {}
@@ -262,16 +272,16 @@ def consolidate_replicated_entries(
             if isinstance(entry, ChunkedTensorEntry) and isinstance(
                 current, ChunkedTensorEntry
             ):
-                # Per-chunk: prefer batched (slab-located) chunk versions.
                 merged_chunks = []
                 for cur_chunk, new_chunk in zip(current.chunks, entry.chunks):
                     merged_chunks.append(
                         new_chunk
-                        if new_chunk.tensor.location.startswith("batched/")
+                        if writer_marked(new_chunk.tensor)
+                        and not writer_marked(cur_chunk.tensor)
                         else cur_chunk
                     )
                 current.chunks = merged_chunks
-            elif getattr(entry, "location", "").startswith("batched/"):
+            elif writer_marked(entry) and not writer_marked(current):
                 authoritative[path] = entry
 
     for r in range(world_size):

@@ -245,9 +245,18 @@ class _WritePipeline:
         self.staging_cost = write_req.buffer_stager.get_staging_cost_bytes()
         self.buf = None
         self.buf_size = 0
+        # True when the stager reported the content is already persisted
+        # (incremental dedup): the request completes with no storage I/O.
+        self.skipped = False
 
     async def stage(self, executor: ThreadPoolExecutor) -> "_WritePipeline":
-        self.buf = await self.write_req.buffer_stager.stage_buffer(executor)
+        from .io_types import SKIP_WRITE
+
+        buf = await self.write_req.buffer_stager.stage_buffer(executor)
+        if buf is SKIP_WRITE:
+            self.skipped = True
+            return self
+        self.buf = buf
         self.buf_size = (
             memoryview(self.buf).cast("B").nbytes if self.buf is not None else 0
         )
@@ -322,7 +331,11 @@ async def execute_write_reqs(
                     # Staged buffer may be smaller than the staging cost
                     # (e.g. cost model overestimates); credit the difference.
                     budget += pipeline.staging_cost - pipeline.buf_size
-                    ready_for_io.append(pipeline)
+                    if pipeline.skipped:
+                        # Dedup'd against a previous snapshot: no I/O.
+                        reporter.report_request_done(0)
+                    else:
+                        ready_for_io.append(pipeline)
                 elif task in io_tasks:
                     io_tasks.discard(task)
                     pipeline = task.result()

@@ -169,6 +169,7 @@ class Snapshot:
         storage_options: Optional[Dict[str, Any]] = None,
         comm: Optional[Communicator] = None,
         per_key_barrier: bool = False,
+        incremental_from: Optional[str] = None,
         _custom_array_prepare_func: Optional[Any] = None,
     ) -> "Snapshot":
         """``_custom_array_prepare_func(logical_path, arr, tracing)``
@@ -178,6 +179,20 @@ class Snapshot:
         (``jax.eval_shape`` — zero FLOPs) to learn the stored
         dtype/shape; at stage time it runs for real. It must not change
         the shape, and must be deterministic.
+
+        ``incremental_from`` makes this an INCREMENTAL snapshot against a
+        previous one at that path (same scheme/bucket; typically a
+        sibling directory): any blob whose staged bytes hash to the same
+        stage-time checksums (whole-blob + tile-grain CRCs, plus matching
+        dtype/shape/box) skips its storage write, and the new manifest
+        references the previous snapshot's blob by relative location.
+        The result is self-describing and restores/scrubs/read_objects
+        like any snapshot — but it REQUIRES the base snapshot(s) to stay
+        alive; deleting a base breaks the snapshots layered on it
+        (``python -m tpusnap verify`` reports the dangling references).
+        Slab-batched small arrays always rewrite; blobs above the slab
+        threshold, all shards, and large chunks dedup. Pass the same
+        value on every rank.
 
         ``per_key_barrier=True`` restores the reference's barrier
         between every stateful's ``state_dict()`` call (snapshot.py:
@@ -198,6 +213,7 @@ class Snapshot:
                 is_async_snapshot=False,
                 per_key_barrier=per_key_barrier,
                 array_prepare_func=_custom_array_prepare_func,
+                incremental_from=incremental_from,
             )
             pending_io_work.sync_complete(event_loop)
             comm.barrier()
@@ -220,6 +236,7 @@ class Snapshot:
         storage_options: Optional[Dict[str, Any]] = None,
         comm: Optional[Communicator] = None,
         per_key_barrier: bool = False,
+        incremental_from: Optional[str] = None,
         _custom_array_prepare_func: Optional[Any] = None,
     ) -> "PendingSnapshot":
         comm = get_communicator(comm)
@@ -234,6 +251,7 @@ class Snapshot:
             is_async_snapshot=True,
             per_key_barrier=per_key_barrier,
             array_prepare_func=_custom_array_prepare_func,
+            incremental_from=incremental_from,
         )
         # Control returns to training here: staging is complete, the
         # snapshot content is frozen; only storage I/O remains.
@@ -433,6 +451,7 @@ def _take_impl(
     is_async_snapshot: bool,
     per_key_barrier: bool = False,
     array_prepare_func: Optional[Any] = None,
+    incremental_from: Optional[str] = None,
 ):
     """Core take flow. Exactly TWO all-gathers in the default
     multi-process path (the reference issues ~6 collectives,
@@ -561,6 +580,14 @@ def _take_impl(
         path, event_loop, storage_options
     )
 
+    # Incremental snapshot: this rank's view of the base snapshot's
+    # manifest, blob locations rewritten relative to the NEW root.
+    prev_entries: Manifest = {}
+    if incremental_from is not None:
+        prev_entries = _load_prev_entries(
+            incremental_from, storage_options, rank, path, event_loop
+        )
+
     entries: Manifest = dict(manifest)
     write_reqs = []
     replicated_entry_paths: List[str] = []
@@ -578,6 +605,7 @@ def _take_impl(
                 else None
             ),
             array_prepare_traced=traced_geometry.get(logical_path),
+            prev_entry=prev_entries.get(logical_path),
         )
         entries[logical_path] = entry
         if is_repl and is_replicated(entry):
@@ -618,6 +646,97 @@ def _take_impl(
         version=__version__, world_size=comm.world_size, manifest=global_manifest
     )
     return pending_io_work, metadata, path, storage
+
+
+def _relative_ref_prefix(base_path: str, new_path: str) -> str:
+    """Relative reference from the NEW snapshot root to the BASE
+    snapshot root (``"../step_1000"`` for siblings). Cross-snapshot blob
+    references are stored relative so a snapshot tree moves/renames as a
+    unit; both snapshots must live on the same scheme and bucket/host."""
+    import os
+    import posixpath
+    from urllib.parse import urlsplit
+
+    a, b = urlsplit(base_path), urlsplit(new_path)
+    if a.scheme != b.scheme or a.netloc != b.netloc:
+        raise ValueError(
+            f"incremental_from {base_path!r} must share the scheme and "
+            f"bucket/host of the snapshot path {new_path!r}"
+        )
+    if a.scheme in ("", "file"):
+        pa, pb = os.path.abspath(a.path or base_path), os.path.abspath(
+            b.path or new_path
+        )
+    else:
+        pa, pb = a.path, b.path
+    rel = posixpath.relpath(pa, pb)
+    if rel == ".":
+        raise ValueError(
+            "incremental_from must name a different snapshot than the one "
+            "being taken"
+        )
+    return rel
+
+
+def _rewrite_entry_locations(entry: Entry, rel_prefix: str) -> Entry:
+    """Deep copy of ``entry`` with every blob location re-expressed
+    relative to the new snapshot root (collapsing chained references:
+    a base that itself references an older base resolves to the older
+    one directly, so incremental chains do not deepen lookups)."""
+    import copy
+    import posixpath
+
+    from .manifest import ChunkedTensorEntry, ObjectEntry, ShardedEntry, TensorEntry
+
+    e = copy.deepcopy(entry)
+
+    def fix(t):
+        t.location = posixpath.normpath(posixpath.join(rel_prefix, t.location))
+
+    if isinstance(e, (TensorEntry, ObjectEntry)):
+        fix(e)
+    elif isinstance(e, ChunkedTensorEntry):
+        for c in e.chunks:
+            fix(c.tensor)
+    elif isinstance(e, ShardedEntry):
+        for s in e.shards:
+            fix(s.tensor)
+    return e
+
+
+def _load_prev_entries(
+    incremental_from: str,
+    storage_options: Optional[Dict[str, Any]],
+    rank: int,
+    new_path: str,
+    event_loop: asyncio.AbstractEventLoop,
+) -> Manifest:
+    """This rank's manifest view of the base snapshot (replicated
+    re-expansion + sharded merge, like restore uses), with every blob
+    location rewritten relative to the new snapshot root — ready to hand
+    to ``prepare_write`` as dedup candidates."""
+    rel_prefix = _relative_ref_prefix(incremental_from, new_path)
+    storage = url_to_storage_plugin_in_event_loop(
+        incremental_from, event_loop, storage_options
+    )
+    try:
+        read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+        try:
+            storage.sync_read(read_io, event_loop)
+            prev_md = SnapshotMetadata.from_yaml(
+                read_io.buf.getvalue().decode("utf-8")
+            )
+        except Exception as e:
+            raise RuntimeError(
+                f"incremental_from={incremental_from!r} is not a readable "
+                "snapshot (missing or corrupt .snapshot_metadata)"
+            ) from e
+    finally:
+        storage.sync_close(event_loop)
+    view = get_manifest_for_rank(prev_md, rank)
+    return {
+        p: _rewrite_entry_locations(e, rel_prefix) for p, e in view.items()
+    }
 
 
 def _gather_manifest(entries: Manifest, comm: Communicator) -> Manifest:

@@ -30,7 +30,11 @@ class FsspecStoragePlugin(StoragePlugin):
         )
 
     def _full(self, path: str) -> str:
-        return f"{self.root}/{path}" if self.root else path
+        # normpath collapses "../" segments: incremental snapshots
+        # reference base-snapshot blobs relative to their own root.
+        import posixpath
+
+        return posixpath.normpath(f"{self.root}/{path}") if self.root else path
 
     def _write_blocking(self, path: str, buf) -> None:
         full = self._full(path)

@@ -117,7 +117,11 @@ class GCSStoragePlugin(StoragePlugin):
         )
 
     def _object_name(self, path: str) -> str:
-        return f"{self.root}/{path}"
+        # normpath collapses "../" segments: incremental snapshots
+        # reference base-snapshot blobs relative to their own root.
+        import posixpath
+
+        return posixpath.normpath(f"{self.root}/{path}")
 
     # --- blocking primitives, run in the executor ------------------------
 

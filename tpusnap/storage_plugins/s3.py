@@ -58,7 +58,11 @@ class S3StoragePlugin(StoragePlugin):
         return self._client
 
     def _key(self, path: str) -> str:
-        return f"{self.root}/{path}"
+        # normpath collapses "../" segments: incremental snapshots
+        # reference base-snapshot blobs relative to their own root.
+        import posixpath
+
+        return posixpath.normpath(f"{self.root}/{path}")
 
     async def write(self, write_io: WriteIO) -> None:
         client = await self._get_client()
