@@ -1,0 +1,118 @@
+"""Incremental snapshot + integrity scrub benchmark.
+
+No reference counterpart (torchsnapshot rewrites every byte every take
+and cannot detect corruption). Simulates the common training shape: a
+large mostly-frozen component (embeddings / frozen tower) plus a small
+hot component that changes every step. Reports, best-of-N:
+
+- full take of the whole state (the baseline every checkpoint pays
+  without dedup),
+- incremental take after the hot component changed (only it rewrites),
+- bytes on disk for the increment vs the full snapshot,
+- scrub throughput (``verify_snapshot`` over the full snapshot).
+
+Run: python benchmarks/incremental/main.py [--gb 2.0] [--hot-mb 64]
+"""
+
+import argparse
+import glob
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+def du(path: str) -> int:
+    return sum(
+        os.path.getsize(f)
+        for f in glob.glob(os.path.join(path, "**", "*"), recursive=True)
+        if os.path.isfile(f)
+    )
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gb", type=float, default=2.0)
+    parser.add_argument("--hot-mb", type=float, default=64.0)
+    parser.add_argument("--runs", type=int, default=3)
+    args = parser.parse_args()
+
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict, verify_snapshot
+
+    frozen_nbytes = int(args.gb * 1024**3)
+    hot_nbytes = int(args.hot_mb * 1024**2)
+    rng = np.random.default_rng(0)
+    frozen = rng.integers(0, 2**16, frozen_nbytes // 2, dtype=np.uint16).reshape(
+        -1, 4096
+    )
+    hot = rng.standard_normal(hot_nbytes // 4).astype(np.float32)
+    total_gb = (frozen.nbytes + hot.nbytes) / 1e9
+    print(
+        f"state: {total_gb:.2f} GB ({frozen.nbytes / 1e9:.2f} frozen + "
+        f"{hot.nbytes / 1e6:.0f} MB hot)"
+    )
+
+    root = tempfile.mkdtemp(prefix="tpusnap_inc_bench_")
+    try:
+        full_times, inc_times = [], []
+        for run in range(args.runs):
+            base = os.path.join(root, f"base{run}")
+            inc = os.path.join(root, f"inc{run}")
+            state = {"app": StateDict(frozen=frozen, hot=hot)}
+            t0 = time.perf_counter()
+            Snapshot.take(base, state)
+            full_times.append(time.perf_counter() - t0)
+
+            hot2 = hot + np.float32(run + 1)
+            t0 = time.perf_counter()
+            Snapshot.take(
+                inc,
+                {"app": StateDict(frozen=frozen, hot=hot2)},
+                incremental_from=base,
+            )
+            inc_times.append(time.perf_counter() - t0)
+            inc_bytes, base_bytes = du(inc), du(base)
+            if run + 1 < args.runs:
+                shutil.rmtree(base)
+                shutil.rmtree(inc)
+
+        t_full, t_inc = min(full_times), min(inc_times)
+        print(
+            f"full take:        {t_full:.2f}s ({total_gb / t_full:.2f} GB/s) "
+            f"runs={[round(t, 2) for t in full_times]}"
+        )
+        print(
+            f"incremental take: {t_inc:.2f}s ({total_gb / t_inc:.2f} GB/s "
+            f"effective, {t_full / t_inc:.1f}x) "
+            f"runs={[round(t, 2) for t in inc_times]}"
+        )
+        print(
+            f"bytes on disk:    full {base_bytes / 1e9:.2f} GB, "
+            f"increment {inc_bytes / 1e6:.1f} MB "
+            f"({base_bytes / max(inc_bytes, 1):.0f}x smaller)"
+        )
+
+        scrub_times = []
+        for _ in range(args.runs):
+            t0 = time.perf_counter()
+            report = verify_snapshot(base)
+            scrub_times.append(time.perf_counter() - t0)
+            assert report.clean, report.summary()
+        t_scrub = min(scrub_times)
+        print(
+            f"scrub (verify):   {t_scrub:.2f}s ({total_gb / t_scrub:.2f} GB/s) "
+            f"runs={[round(t, 2) for t in scrub_times]}"
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -326,3 +326,30 @@ def test_multirank_replicated_incremental(tmp_path):
     )}
     Snapshot(inc_b).restore(tgt2)
     assert np.array_equal(tgt2["model"]["w2"], expect * 2 + 1.0)
+
+
+def test_incremental_refuses_with_checksums_disabled(tmp_path):
+    from tpusnap.knobs import override_checksum_disabled
+
+    base = str(tmp_path / "s0")
+    Snapshot.take(base, {"app": StateDict(x=np.ones(4, np.float32))})
+    with override_checksum_disabled(True):
+        with pytest.raises(ValueError, match="checksum"):
+            Snapshot.take(
+                str(tmp_path / "s1"),
+                {"app": StateDict(x=np.ones(4, np.float32))},
+                incremental_from=base,
+            )
+
+
+def test_cli_info_numeric_base_name(tmp_path, capsys):
+    """Bases named by bare step number must display correctly."""
+    from tpusnap.__main__ import main as cli_main
+
+    base, inc = str(tmp_path / "1000"), str(tmp_path / "1100")
+    with override_batching_disabled(True):
+        Snapshot.take(base, {"app": _state()})
+        Snapshot.take(inc, {"app": _state()}, incremental_from=base)
+    assert cli_main(["info", inc]) == 0
+    out = capsys.readouterr().out
+    assert "../1000" in out, out

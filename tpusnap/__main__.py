@@ -85,12 +85,20 @@ def _base_root(location: str) -> str:
     """Base-snapshot root (relative to this snapshot) of an external blob
     location: everything before the storage-layout segment (``<rank>/``,
     ``replicated/``, ``sharded/``, ``batched/``) that starts the blob's
-    path within its snapshot."""
+    path within its snapshot. The first segment after the leading ``..``
+    run always belongs to the base path (a relative reference descends
+    into the base's own directory name), so a base snapshot named by a
+    bare step number ("../1000/0/app/w") parses correctly."""
     segs = location.split("/")
-    for i, s in enumerate(segs):
-        if s.isdigit() or s in ("replicated", "sharded", "batched"):
-            return "/".join(segs[:i]) or location
-    return location
+    i = 0
+    while i < len(segs) and segs[i] == "..":
+        i += 1
+    j = i + 1
+    while j < len(segs) and not (
+        segs[j].isdigit() or segs[j] in ("replicated", "sharded", "batched")
+    ):
+        j += 1
+    return "/".join(segs[:j]) if j < len(segs) else location
 
 
 def cmd_ls(args) -> int:

@@ -584,6 +584,15 @@ def _take_impl(
     # manifest, blob locations rewritten relative to the NEW root.
     prev_entries: Manifest = {}
     if incremental_from is not None:
+        from .knobs import is_checksum_disabled
+
+        if is_checksum_disabled():
+            # Dedup compares stage-time checksums; without them every
+            # blob would silently rewrite in full — refuse instead.
+            raise ValueError(
+                "incremental_from requires checksums; unset "
+                "TPUSNAP_DISABLE_CHECKSUM to take an incremental snapshot"
+            )
         prev_entries = _load_prev_entries(
             incremental_from, storage_options, rank, path, event_loop
         )
