@@ -3,10 +3,12 @@
 Operational tooling over the manifest + checksum machinery (no reference
 counterpart — torchsnapshot ships no CLI and no integrity checking):
 
-  info   PATH           snapshot version, world size, size breakdown
-  ls     PATH [-l]      list manifest entries (one line per logical path)
-  verify PATH           stream-verify every blob against recorded CRCs
-  cat    PATH MANIFEST_PATH   read one object (``read_object``) and print it
+  info        PATH      snapshot version, world size, size breakdown
+  ls          PATH [-l] list manifest entries (one line per logical path)
+  verify      PATH      stream-verify every blob against recorded CRCs
+  cat         PATH MANIFEST_PATH  read one object (``read_object``), print it
+  materialize PATH      copy base-referenced blobs into an incremental
+                        snapshot so its bases can be deleted
 
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found.
 """
@@ -73,32 +75,15 @@ def cmd_info(args) -> int:
     for t, c in sorted(counts.items()):
         print(f"  {t:14s} {c}")
     if external:
-        bases = sorted({_base_root(b.location) for b in external})
+        from .inspect import base_root_of_location
+
+        bases = sorted({base_root_of_location(b.location) for b in external})
         print(
             f"external:    {len(external)} blob range(s) reference base "
-            f"snapshot(s): {', '.join(bases)} — keep them alive"
+            f"snapshot(s): {', '.join(bases)} — keep them alive (or "
+            f"`materialize` to make this snapshot self-contained)"
         )
     return 0
-
-
-def _base_root(location: str) -> str:
-    """Base-snapshot root (relative to this snapshot) of an external blob
-    location: everything before the storage-layout segment (``<rank>/``,
-    ``replicated/``, ``sharded/``, ``batched/``) that starts the blob's
-    path within its snapshot. The first segment after the leading ``..``
-    run always belongs to the base path (a relative reference descends
-    into the base's own directory name), so a base snapshot named by a
-    bare step number ("../1000/0/app/w") parses correctly."""
-    segs = location.split("/")
-    i = 0
-    while i < len(segs) and segs[i] == "..":
-        i += 1
-    j = i + 1
-    while j < len(segs) and not (
-        segs[j].isdigit() or segs[j] in ("replicated", "sharded", "batched")
-    ):
-        j += 1
-    return "/".join(segs[:j]) if j < len(segs) else location
 
 
 def cmd_ls(args) -> int:
@@ -130,6 +115,21 @@ def cmd_verify(args) -> int:
             print(f"UNVERIFIED  {u.manifest_path}: {u.detail}")
     print(report.summary())
     return 0 if report.clean else 2
+
+
+def cmd_materialize(args) -> int:
+    from .inspect import materialize_snapshot
+
+    stats = materialize_snapshot(args.path)
+    if stats["blobs_copied"] == 0:
+        print("already self-contained (no external references)")
+    else:
+        print(
+            f"copied {stats['blobs_copied']} blob(s), "
+            f"{_fmt_bytes(stats['bytes_copied'])}; snapshot is now "
+            "self-contained"
+        )
+    return 0
 
 
 def cmd_cat(args) -> int:
@@ -167,6 +167,14 @@ def main(argv=None) -> int:
     p.add_argument("path")
     p.add_argument("manifest_path", help='"<rank>/<logical_path>"')
     p.set_defaults(fn=cmd_cat)
+
+    p = sub.add_parser(
+        "materialize",
+        help="copy base-referenced blobs into an incremental snapshot, "
+        "making it self-contained",
+    )
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_materialize)
 
     try:
         args = parser.parse_args(argv)

@@ -39,11 +39,34 @@ from .serialization import tensor_nbytes
 __all__ = [
     "BlobCheck",
     "ScrubReport",
+    "base_root_of_location",
     "entry_nbytes",
     "entry_verifiable",
     "iter_blobs",
+    "materialize_snapshot",
     "verify_snapshot",
 ]
+
+
+def base_root_of_location(location: str) -> str:
+    """Base-snapshot root (relative to the referencing snapshot) of an
+    external blob location: everything before the storage-layout segment
+    (``<rank>/``, ``replicated/``, ``sharded/``, ``batched/``) that
+    starts the blob's path within its own snapshot. The first segment
+    after the leading ``..`` run always belongs to the base path (a
+    relative reference descends into the base's directory name), so a
+    base named by a bare step number ("../1000/0/app/w") parses
+    correctly."""
+    segs = location.split("/")
+    i = 0
+    while i < len(segs) and segs[i] == "..":
+        i += 1
+    j = i + 1
+    while j < len(segs) and not (
+        segs[j].isdigit() or segs[j] in ("replicated", "sharded", "batched")
+    ):
+        j += 1
+    return "/".join(segs[:j]) if j < len(segs) else location
 
 
 def entry_verifiable(entry: Entry) -> bool:
@@ -193,6 +216,144 @@ def iter_blobs(manifest: Manifest) -> Iterator[_Blob]:
                 continue
             seen.add(key)
             yield b
+
+
+def _entry_tensors(entry: Entry):
+    """Every TensorEntry/ObjectEntry carrying a ``location`` in ``entry``."""
+    if isinstance(entry, (TensorEntry, ObjectEntry)):
+        yield entry
+    elif isinstance(entry, ChunkedTensorEntry):
+        for c in entry.chunks:
+            yield c.tensor
+    elif isinstance(entry, ShardedEntry):
+        for s in entry.shards:
+            yield s.tensor
+
+
+def materialize_snapshot(
+    path: str,
+    storage_options: Optional[Dict[str, Any]] = None,
+    resources: Optional[
+        Tuple[asyncio.AbstractEventLoop, StoragePlugin]
+    ] = None,
+) -> Dict[str, int]:
+    """Make an incremental snapshot self-contained: copy every blob it
+    references from base snapshots (``../`` locations) into this
+    snapshot, rewrite the manifest, and re-commit ``.snapshot_metadata``.
+    Afterwards the base snapshot(s) may be deleted.
+
+    Blobs are copied whole (slab references keep their byte ranges), one
+    at a time — peak memory is the largest single blob (bounded by the
+    max-chunk/max-shard knobs, 512 MB default). Before the manifest is
+    committed, every copied range is verified against its recorded
+    checksum — bit-rot in a base is caught HERE, while the base still
+    exists, not after the user deleted it. The metadata rewrite itself is
+    atomic (temp + rename on fs; single PUT on object stores), so a
+    failure at any point leaves the snapshot valid and base-referencing.
+
+    ``resources`` lets a caller pass an existing (loop, storage) pair
+    (``Snapshot.materialize`` reuses its cached ones); they are left
+    open. Returns ``{"blobs_copied": N, "bytes_copied": N}``.
+    """
+    from .io_types import WriteIO
+    from .storage_plugin import url_to_storage_plugin_in_event_loop
+
+    owns_resources = resources is None
+    if owns_resources:
+        event_loop = asyncio.new_event_loop()
+        storage = None
+    else:
+        event_loop, storage = resources
+    local_for: Dict[str, str] = {}
+    bytes_copied = 0
+    try:
+        if storage is None:
+            storage = url_to_storage_plugin_in_event_loop(
+                path, event_loop, storage_options
+            )
+        try:
+            from .snapshot import SNAPSHOT_METADATA_FNAME
+
+            read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+            storage.sync_read(read_io, event_loop)
+            metadata = SnapshotMetadata.from_yaml(
+                read_io.buf.getvalue().decode("utf-8")
+            )
+
+            # Map each distinct external location to its local home: the
+            # blob's path within its base snapshot (unique — locations
+            # embed logical paths or slab uuids).
+            for entry in metadata.manifest.values():
+                for t in _entry_tensors(entry):
+                    if not t.location.startswith("../"):
+                        continue
+                    base = base_root_of_location(t.location)
+                    local = t.location[len(base) + 1 :]
+                    prior = local_for.setdefault(t.location, local)
+                    if prior != local:  # pragma: no cover - defensive
+                        raise RuntimeError(
+                            f"conflicting local paths for {t.location!r}"
+                        )
+            if not local_for:
+                return {"blobs_copied": 0, "bytes_copied": 0}
+            collisions: Dict[str, str] = {}
+            for ext, local in local_for.items():
+                if collisions.setdefault(local, ext) != ext:
+                    raise RuntimeError(
+                        f"two base blobs ({collisions[local]!r}, {ext!r}) "
+                        f"map to the same local path {local!r}; cannot "
+                        "materialize"
+                    )
+
+            for ext, local in sorted(local_for.items()):
+                blob_io = ReadIO(path=ext)  # whole object
+                storage.sync_read(blob_io, event_loop)
+                data = blob_io.buf.getbuffer()
+                storage.sync_write(WriteIO(path=local, buf=data), event_loop)
+                bytes_copied += data.nbytes
+
+            for entry in metadata.manifest.values():
+                for t in _entry_tensors(entry):
+                    if t.location in local_for:
+                        t.location = local_for[t.location]
+
+            # Verify the copied bytes against the manifest checksums
+            # BEFORE committing: corruption in a base must surface while
+            # the base still exists, not after the user retires it.
+            copied_locations = set(local_for.values())
+            scratch: Dict[str, Any] = {}
+            bad: List[BlobCheck] = []
+            for blob in iter_blobs(metadata.manifest):
+                if blob.location not in copied_locations:
+                    continue
+                check = _verify_one(storage, event_loop, blob, scratch)
+                if check.status == "corrupt":
+                    bad.append(check)
+            if bad:
+                detail = "; ".join(
+                    f"{c.manifest_path} ({c.detail})" for c in bad[:5]
+                )
+                raise RuntimeError(
+                    f"{len(bad)} copied blob range(s) failed checksum "
+                    f"verification — the BASE snapshot is corrupt; the "
+                    f"manifest was NOT rewritten and still references the "
+                    f"base: {detail}"
+                )
+
+            storage.sync_write_atomic(
+                WriteIO(
+                    path=SNAPSHOT_METADATA_FNAME,
+                    buf=metadata.to_yaml().encode("utf-8"),
+                ),
+                event_loop,
+            )
+        finally:
+            if owns_resources:
+                storage.sync_close(event_loop)
+    finally:
+        if owns_resources:
+            event_loop.close()
+    return {"blobs_copied": len(local_for), "bytes_copied": bytes_copied}
 
 
 def _verify_one(

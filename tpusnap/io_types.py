@@ -174,6 +174,15 @@ class StoragePlugin(abc.ABC):
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None: ...
 
+    async def write_atomic(self, write_io: WriteIO) -> None:
+        """Write that either fully lands or leaves any existing object
+        untouched. Object stores are per-PUT atomic already, so the
+        default delegates to ``write``; filesystem plugins override with
+        temp-file + rename (a plain truncate-then-write would destroy a
+        previously valid file on a mid-write crash — this matters when
+        REWRITING committed metadata, e.g. ``materialize``)."""
+        await self.write(write_io)
+
     @abc.abstractmethod
     async def read(self, read_io: ReadIO) -> None: ...
 
@@ -189,6 +198,11 @@ class StoragePlugin(abc.ABC):
         self, write_io: WriteIO, event_loop: Optional[asyncio.AbstractEventLoop] = None
     ) -> None:
         _run(self.write(write_io), event_loop)
+
+    def sync_write_atomic(
+        self, write_io: WriteIO, event_loop: Optional[asyncio.AbstractEventLoop] = None
+    ) -> None:
+        _run(self.write_atomic(write_io), event_loop)
 
     def sync_read(
         self, read_io: ReadIO, event_loop: Optional[asyncio.AbstractEventLoop] = None

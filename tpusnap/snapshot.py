@@ -380,6 +380,23 @@ class Snapshot:
                 resources=(event_loop, storage),
             )
 
+    def materialize(self) -> Dict[str, int]:
+        """Make an incremental snapshot self-contained by copying every
+        base-referenced blob into it and rewriting the manifest (see
+        :func:`tpusnap.inspect.materialize_snapshot`); afterwards the
+        base snapshot(s) may be deleted. No-op on full snapshots."""
+        from .inspect import materialize_snapshot
+
+        with self._op_lock:
+            event_loop, storage = self._resources()
+            stats = materialize_snapshot(
+                self.path,
+                self._storage_options,
+                resources=(event_loop, storage),
+            )
+            self._metadata = None  # manifest was rewritten on disk
+        return stats
+
     # -------------------------------------------------------------- metadata
 
     @property
@@ -743,9 +760,50 @@ def _load_prev_entries(
     finally:
         storage.sync_close(event_loop)
     view = get_manifest_for_rank(prev_md, rank)
+
+    # Dedup compares stage-time checksums against the base's. A base
+    # taken with checksums disabled (or by a build with a different
+    # checksum algorithm) can never match — every blob would silently
+    # rewrite in full, the exact outcome incremental_from exists to
+    # avoid. Refuse up front while the user can still fix it.
+    from . import _native
+    from .inspect import entry_nbytes
+
+    algo_prefix = _native.checksum_algorithm() + ":"
+    blob_entries = [e for e in view.values() if entry_nbytes(e) > 0]
+    usable = any(
+        (t.checksum or "").startswith(algo_prefix)
+        for e in blob_entries
+        for t in _prev_entry_tensors(e)
+    )
+    if blob_entries and not usable:
+        raise ValueError(
+            f"incremental_from={incremental_from!r} carries no "
+            f"{algo_prefix[:-1]} checksums (taken with checksums disabled "
+            "or by a different build?) — dedup is impossible, every blob "
+            "would silently rewrite in full"
+        )
     return {
         p: _rewrite_entry_locations(e, rel_prefix) for p, e in view.items()
     }
+
+
+def _prev_entry_tensors(entry: Entry):
+    from .manifest import (
+        ChunkedTensorEntry,
+        ObjectEntry,
+        ShardedEntry,
+        TensorEntry,
+    )
+
+    if isinstance(entry, (TensorEntry, ObjectEntry)):
+        yield entry
+    elif isinstance(entry, ChunkedTensorEntry):
+        for c in entry.chunks:
+            yield c.tensor
+    elif isinstance(entry, ShardedEntry):
+        for s in entry.shards:
+            yield s.tensor
 
 
 def _gather_manifest(entries: Manifest, comm: Communicator) -> Manifest:
@@ -767,7 +825,9 @@ def _write_metadata(
     metadata: SnapshotMetadata,
     event_loop: asyncio.AbstractEventLoop,
 ) -> None:
-    storage.sync_write(
+    # Atomic (temp+rename on fs): a crash mid-write must not leave a
+    # torn metadata file — it would be indistinguishable from corruption.
+    storage.sync_write_atomic(
         WriteIO(
             path=SNAPSHOT_METADATA_FNAME,
             buf=metadata.to_yaml().encode("utf-8"),

@@ -71,6 +71,27 @@ class FSStoragePlugin(StoragePlugin):
             async with aiofiles.open(path, "wb") as f:
                 await f.write(buf)
 
+    async def write_atomic(self, write_io: WriteIO) -> None:
+        """Temp-file + rename: a crash mid-write never destroys an
+        existing file at the destination."""
+        path = pathlib.Path(os.path.join(self.root, write_io.path))
+        self._ensure_parent(path)
+        tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
+        loop = asyncio.get_running_loop()
+
+        def work():
+            try:
+                _write_file(tmp, write_io.buf)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+
+        await loop.run_in_executor(self._get_executor(), work)
+
     async def read(self, read_io: ReadIO) -> None:
         path = os.path.join(self.root, read_io.path)
         if read_io.byte_range is not None:
