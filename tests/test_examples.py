@@ -55,3 +55,10 @@ def test_transformer_example(tmp_path):
 @pytest.mark.distributed
 def test_distributed_example(tmp_path):
     _run_example("distributed_example.py", "--work-dir", str(tmp_path))
+
+
+def test_incremental_example(tmp_path):
+    out = _run_example("incremental_example.py", "--work-dir", str(tmp_path))
+    assert "incremental on" in out
+    assert "0 corrupt" in out
+    assert "bit-exact" in out
