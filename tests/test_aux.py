@@ -129,3 +129,22 @@ class TestAdviseHugepages:
         bf16 = np.ones(1 << 21, dtype=ml_dtypes.bfloat16)
         _native.advise_hugepages(bf16)
         assert (bf16 == 1).all()
+
+
+def test_orbax_trick_incremental(tmp_path):
+    import numpy as np
+
+    from tpusnap import verify_snapshot
+    from tpusnap.tricks.orbax import PyTreeCheckpointer
+
+    ckpt = PyTreeCheckpointer()
+    tree = {"w": np.arange(4096, dtype=np.float32), "step": 1}
+    base, inc = tmp_path / "c0", tmp_path / "c1"
+    ckpt.save(base, tree)
+    ckpt.save(inc, tree, incremental_from=base)
+    restored = ckpt.restore(inc)
+    assert np.array_equal(restored["w"], tree["w"])
+    assert verify_snapshot(str(inc)).clean
+    pending = ckpt.async_save(tmp_path / "c2", tree, incremental_from=inc)
+    pending.wait()
+    assert verify_snapshot(str(tmp_path / "c2")).clean

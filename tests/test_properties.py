@@ -1,0 +1,182 @@
+"""Property-based tests (hypothesis) for the pure data-model layers.
+
+The reference's unit tests enumerate hand-picked hostile cases
+(tests/test_flatten.py's %-and-/ keys, test_manifest.py's fixtures);
+these generate them: arbitrary nested state round-trips through
+flatten/inflate, arbitrary entries through the manifest serialization,
+arbitrary floats through the bit-exact primitive encoding, and the
+CRC-combine identity over arbitrary byte splits.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from tpusnap.flatten import flatten, inflate
+from tpusnap.manifest import (
+    PrimitiveEntry,
+    SnapshotMetadata,
+    TensorEntry,
+    entry_from_dict,
+    _entry_to_dict,
+    is_container_entry,
+)
+
+SETTINGS = settings(max_examples=80, deadline=None)
+
+# Keys exercise the %/escaping and the str/int dichotomy; values cover
+# every primitive class plus nesting.
+_keys = st.one_of(
+    st.text(
+        alphabet=st.sampled_from("ab%/_.0 é"), min_size=1, max_size=8
+    ),
+    st.integers(min_value=0, max_value=99),
+)
+_primitives = st.one_of(
+    st.integers(min_value=-(2**62), max_value=2**62),
+    st.floats(allow_nan=False),  # NaN breaks dict-equality comparison only
+    st.booleans(),
+    st.text(max_size=12),
+    st.binary(max_size=12),
+)
+_leaves = _primitives
+
+
+def _unique_str_int_keys(d: dict) -> bool:
+    # flatten refuses colliding str(int_key) == str_key pairs; generated
+    # dicts must not rely on them.
+    return len({str(k) for k in d}) == len(d)
+
+
+_state = st.recursive(
+    _leaves,
+    lambda children: st.one_of(
+        st.lists(children, max_size=4),
+        st.dictionaries(_keys, children, max_size=4).filter(
+            _unique_str_int_keys
+        ),
+        st.tuples(children, children),
+    ),
+    max_leaves=12,
+)
+
+
+@SETTINGS
+@given(st.dictionaries(_keys, _state, min_size=1, max_size=4).filter(_unique_str_int_keys))
+def test_flatten_inflate_roundtrip(state):
+    manifest, flattened = flatten(state, prefix="app")
+    # Every flattened path must be addressable and escaping reversible.
+    rebuilt = inflate(
+        {p: e for p, e in manifest.items() if is_container_entry(e)},
+        flattened,
+        prefix="app",
+    )
+    assert _norm(rebuilt) == _norm(state)
+
+
+def _norm(obj):
+    """Tuples inflate as tuples, lists as lists; normalize int-keyed dict
+    keys like flatten does (both 1 and "1" address the same child)."""
+    if isinstance(obj, dict):
+        return {str(k): _norm(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_norm(v) for v in obj)
+    return obj
+
+
+@SETTINGS
+@given(st.floats())  # incl. nan/inf/-0.0/subnormals
+def test_primitive_float_bit_exact(x):
+    entry = PrimitiveEntry.from_object(x)
+    d = _entry_to_dict(entry)
+    back = entry_from_dict(d).get_value()
+    assert isinstance(back, float)
+    # Bit-exact, not just ==: compare the IEEE-754 payloads.
+    import struct
+
+    assert struct.pack("<d", back) == struct.pack("<d", x)
+
+
+@SETTINGS
+@given(
+    st.integers(min_value=-(2**62), max_value=2**62)
+    | st.booleans()
+    | st.text(max_size=20)
+    | st.binary(max_size=20)
+)
+def test_primitive_roundtrip(x):
+    entry = PrimitiveEntry.from_object(x)
+    back = entry_from_dict(_entry_to_dict(entry)).get_value()
+    assert type(back) is type(x) and back == x
+
+
+@SETTINGS
+@given(
+    st.lists(
+        st.tuples(
+            st.text(
+                alphabet=st.characters(
+                    blacklist_categories=("Cs",), min_codepoint=32
+                ),
+                min_size=1,
+                max_size=16,
+            ),
+            st.sampled_from(["float32", "bfloat16", "int8", "uint16"]),
+            st.lists(st.integers(0, 7), max_size=3),
+        ),
+        min_size=1,
+        max_size=5,
+        unique_by=lambda t: t[0],
+    )
+)
+def test_metadata_yaml_roundtrip(specs):
+    manifest = {
+        f"0/{name}": TensorEntry(
+            location=f"0/{name}",
+            serializer="buffer_protocol",
+            dtype=dtype,
+            shape=shape,
+            replicated=False,
+            checksum="crc32c:00000000",
+        )
+        for name, dtype, shape in specs
+    }
+    md = SnapshotMetadata(version="0.1.0", world_size=1, manifest=manifest)
+    back = SnapshotMetadata.from_yaml(md.to_yaml())
+    assert back.to_dict() == md.to_dict()
+
+
+@SETTINGS
+@given(st.binary(max_size=4096), st.binary(max_size=4096))
+def test_crc_combine_identity(a, b):
+    from tpusnap import _native
+
+    assert _native.crc_combine(
+        _native.crc32c(a), _native.crc32c(b), len(b)
+    ) == _native.crc32c(a + b)
+
+
+@SETTINGS
+@given(
+    st.binary(min_size=0, max_size=2048),
+    st.integers(min_value=1, max_value=512),
+)
+def test_memcpy_crc_tiles_matches_direct(data, tile):
+    from tpusnap import _native
+
+    src = np.frombuffer(data, dtype=np.uint8).copy()
+    dst = np.zeros_like(src)
+    crcs = _native.memcpy_crc_tiles(dst, src, tile)
+    assert bytes(dst) == data
+    n = len(data)
+    t = min(tile, n) if n else tile
+    if n:
+        expect = [
+            _native.crc32c(data[i : min(i + t, n)]) for i in range(0, n, t)
+        ]
+        assert crcs == expect
+    # Folding the tiles reproduces the whole-buffer value.
+    combined = crcs[0]
+    for i, c in enumerate(crcs[1:], 1):
+        ln = min((i + 1) * t, n) - i * t
+        combined = _native.crc_combine(combined, c, ln)
+    assert combined == _native.crc32c(data)

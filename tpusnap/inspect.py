@@ -243,11 +243,12 @@ def materialize_snapshot(
     Afterwards the base snapshot(s) may be deleted.
 
     Blobs are copied whole (slab references keep their byte ranges), one
-    at a time — peak memory is the largest single blob (bounded by the
-    max-chunk/max-shard knobs, 512 MB default). Before the manifest is
-    committed, every copied range is verified against its recorded
-    checksum — bit-rot in a base is caught HERE, while the base still
-    exists, not after the user deleted it. The metadata rewrite itself is
+    at a time. Before the manifest is committed, every copied range is
+    verified against its recorded checksum — bit-rot in a base is caught
+    HERE, while the base still exists, not after the user deleted it;
+    the verification keeps 4 reads in flight, so peak memory is up to 4
+    scratch buffers of the largest copied blob (bounded by the
+    max-chunk/max-shard knobs, 512 MB class each). The metadata rewrite itself is
     atomic (temp + rename on fs; single PUT on object stores), so a
     failure at any point leaves the snapshot valid and base-referencing.
 
@@ -435,13 +436,13 @@ def _run_verifications(
     range a slot sees."""
 
     async def run() -> List[BlobCheck]:
-        work = iter(blobs)  # shared: each slot pulls the next range, O(n)
-        results: List[BlobCheck] = []
+        work = enumerate(blobs)  # shared: each slot pulls the next, O(n)
+        results: List[Tuple[int, BlobCheck]] = []
 
         async def slot() -> None:
             scratch: Dict[str, Any] = {}
-            for blob in work:
-                results.append(await _verify_one(storage, blob, scratch))
+            for i, blob in work:
+                results.append((i, await _verify_one(storage, blob, scratch)))
 
         tasks = [
             asyncio.ensure_future(slot())
@@ -457,7 +458,9 @@ def _run_verifications(
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
             raise
-        return results
+        # Manifest order, not completion order: scrub output must be
+        # deterministic across runs (operators diff it).
+        return [c for _, c in sorted(results, key=lambda ic: ic[0])]
 
     from .io_types import run_on_loop
 

@@ -31,18 +31,49 @@ class PyTreeCheckpointer:
 
     _KEY = "pytree"
 
-    def save(self, directory: Any, item: Any, *, force: bool = False, **_: Any) -> None:
+    def save(
+        self,
+        directory: Any,
+        item: Any,
+        *,
+        force: bool = False,
+        incremental_from: Optional[Any] = None,
+        **_: Any,
+    ) -> None:
+        """``incremental_from`` (tpusnap extension): dedup against a
+        previous checkpoint directory — unchanged leaves reference the
+        base instead of rewriting (see ``Snapshot.take``)."""
         path = os.fspath(directory)
         if force:
             self._remove_existing(path)
-        Snapshot.take(path, {self._KEY: PytreeState(item)})
+        Snapshot.take(
+            path,
+            {self._KEY: PytreeState(item)},
+            incremental_from=(
+                os.fspath(incremental_from)
+                if incremental_from is not None
+                else None
+            ),
+        )
 
-    def async_save(self, directory: Any, item: Any) -> PendingSnapshot:
+    def async_save(
+        self,
+        directory: Any,
+        item: Any,
+        *,
+        incremental_from: Optional[Any] = None,
+    ) -> PendingSnapshot:
         """tpusnap extension mirroring orbax's AsyncCheckpointer: returns
         once device buffers are staged; storage I/O and the commit drain
         on a background thread (call ``.wait()`` or let the next save)."""
         return Snapshot.async_take(
-            os.fspath(directory), {self._KEY: PytreeState(item)}
+            os.fspath(directory),
+            {self._KEY: PytreeState(item)},
+            incremental_from=(
+                os.fspath(incremental_from)
+                if incremental_from is not None
+                else None
+            ),
         )
 
     def restore(self, directory: Any, item: Optional[Any] = None, **_: Any) -> Any:
