@@ -1,0 +1,111 @@
+"""Crash consistency and scale-shape regression tests.
+
+The two-phase commit's real-world guarantee: a take killed with SIGKILL
+at any point (no Python cleanup, no atexit) leaves NO
+``.snapshot_metadata`` — the partial snapshot is invisible — and the
+same path remains usable for a subsequent take. The reference asserts
+this only for in-process exceptions (tests/test_async_take.py); a hard
+kill is the stronger claim.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tpusnap import Snapshot, StateDict, verify_snapshot
+
+_TAKE_CHILD = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from tpusnap import Snapshot, StateDict
+
+path = sys.argv[1]
+state = {
+    f"w{i}": np.random.default_rng(i).standard_normal((512, 1024)).astype(np.float32)
+    for i in range(24)
+}  # ~48 MB -> many distinct blob files with batching off
+os.environ["TPUSNAP_DISABLE_BATCHING"] = "1"
+print("READY", flush=True)
+Snapshot.take(path, {"app": StateDict(**state)})
+print("DONE", flush=True)
+"""
+
+
+def test_sigkill_mid_take_leaves_no_metadata(tmp_path):
+    path = str(tmp_path / "snap")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TPUSNAP_DISABLE_BATCHING="1")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _TAKE_CHILD, path],
+        env=env,
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        # Wait for blobs to start appearing, then kill mid-write: blob
+        # files exist, metadata (written last, after the barrier) not.
+        deadline = time.monotonic() + 120
+        killed = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break  # finished before we saw a blob (too fast)
+            if os.path.isdir(path) and any(
+                f != ".snapshot_metadata"
+                for _, _, fs in os.walk(path)
+                for f in fs
+            ):
+                proc.send_signal(signal.SIGKILL)
+                killed = True
+                break
+            time.sleep(0.002)
+        proc.wait(timeout=60)
+        if not killed:
+            pytest.skip("take finished before any blob appeared")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # The invariant: no metadata -> the partial snapshot is invisible.
+    assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+    with pytest.raises(RuntimeError, match="not a snapshot"):
+        Snapshot(path).metadata
+
+    # The same path is reusable; the fresh take overwrites the debris
+    # and scrubs clean.
+    fresh = StateDict(x=np.arange(4096, dtype=np.float32))
+    Snapshot.take(path, {"app": fresh})
+    report = verify_snapshot(path)
+    assert report.clean
+    target = {"app": StateDict(x=np.zeros(4096, np.float32))}
+    Snapshot(path).restore(target)
+    assert np.array_equal(target["app"]["x"], fresh["x"])
+
+
+def test_many_leaf_state_stays_compact(tmp_path):
+    """10k small leaves (the optimizer-state shape) must slab-batch into
+    a handful of files and round-trip; a regression to per-leaf files
+    would blow up metadata and storage-op counts."""
+    rng = np.random.default_rng(0)
+    state = {
+        f"p{i}": rng.standard_normal(64).astype(np.float32)
+        for i in range(10_000)
+    }
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"app": StateDict(**state)})
+    n_files = sum(len(fs) for _, _, fs in os.walk(path))
+    assert n_files <= 8, f"{n_files} files for 10k leaves — batching broken?"
+    target = {
+        "app": StateDict(**{k: np.zeros(64, np.float32) for k in state})
+    }
+    Snapshot(path).restore(target)
+    for k in ("p0", "p5000", "p9999"):
+        assert np.array_equal(target["app"][k], state[k]), k
+    assert verify_snapshot(path).clean
