@@ -261,3 +261,13 @@ def test_multiprocess_snapshot_scrubs_clean_and_detects(tmp_path):
     report = verify_snapshot(path)
     assert not report.clean
     assert any(f.manifest_path.startswith("1/") for f in report.failures)
+
+
+def test_scrub_concurrency_knob(tmp_path, monkeypatch):
+    """TPUSNAP_SCRUB_CONCURRENCY=1 degrades to serial and still verifies."""
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"app": _state()})
+    monkeypatch.setenv("TPUSNAP_SCRUB_CONCURRENCY", "1")
+    assert verify_snapshot(path).clean
+    monkeypatch.setenv("TPUSNAP_SCRUB_CONCURRENCY", "16")
+    assert verify_snapshot(path).clean

@@ -359,9 +359,6 @@ def materialize_snapshot(
     return {"blobs_copied": len(local_for), "bytes_copied": bytes_copied}
 
 
-_SCRUB_CONCURRENCY = 4
-
-
 async def _verify_one(
     storage: StoragePlugin,
     blob: _Blob,
@@ -428,12 +425,16 @@ def _run_verifications(
     storage: StoragePlugin,
     event_loop: asyncio.AbstractEventLoop,
     blobs: List[_Blob],
-    concurrency: int = _SCRUB_CONCURRENCY,
+    concurrency: Optional[int] = None,
 ) -> List[BlobCheck]:
     """Verify blob ranges with ``concurrency`` reads in flight — the scrub
     is latency-bound on serial tile reads otherwise. Each slot owns one
     reusable scratch buffer, so peak memory is concurrency x the largest
-    range a slot sees."""
+    range a slot sees (TPUSNAP_SCRUB_CONCURRENCY, default 4)."""
+    if concurrency is None:
+        from .knobs import get_scrub_concurrency
+
+        concurrency = get_scrub_concurrency()
 
     async def run() -> List[BlobCheck]:
         work = enumerate(blobs)  # shared: each slot pulls the next, O(n)

@@ -26,6 +26,7 @@ _DISABLE_CHECKSUM_ENV_VAR = "TPUSNAP_DISABLE_CHECKSUM"
 _DIRECT_IO_QD_ENV_VAR = "TPUSNAP_DIRECT_IO_QD"
 _DIRECT_IO_CHUNK_ENV_VAR = "TPUSNAP_DIRECT_IO_CHUNK_BYTES"
 _TILE_CHECKSUM_ENV_VAR = "TPUSNAP_TILE_CHECKSUM_BYTES"
+_SCRUB_CONCURRENCY_ENV_VAR = "TPUSNAP_SCRUB_CONCURRENCY"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -114,6 +115,13 @@ def get_direct_io_chunk_bytes() -> int:
 
 def get_tile_checksum_bytes() -> int:
     return _get_int_env(_TILE_CHECKSUM_ENV_VAR, _DEFAULT_TILE_CHECKSUM_BYTES)
+
+
+def get_scrub_concurrency() -> int:
+    """Blob ranges the integrity scrub keeps in flight (peak memory is
+    this many scratch buffers). Raise for high-latency storage (cloud
+    scrubs), lower for tight-memory hosts."""
+    return max(1, _get_int_env(_SCRUB_CONCURRENCY_ENV_VAR, 4))
 
 
 def get_memory_budget_override_bytes() -> Optional[int]:
