@@ -468,3 +468,34 @@ def test_incremental_snapshot_through_gcs(fake_gcs, monkeypatch):
     Snapshot("gs://bkt/snaps/s1", storage_options=opts).restore({"s": target})
     assert np.array_equal(target["w"], state["w"]) and target["step"] == 1
     assert verify_snapshot("gs://bkt/snaps/s1", storage_options=opts).clean
+
+
+def test_materialize_through_gcs(fake_gcs, monkeypatch):
+    """materialize copies base blobs within the gs:// namespace and
+    rewrites the manifest; the base can then be deleted server-side."""
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict, verify_snapshot
+    from tpusnap.inspect import materialize_snapshot
+    from tpusnap.knobs import override_batching_disabled
+
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", fake_gcs.endpoint)
+    opts = {"api_endpoint": fake_gcs.endpoint, "deadline_sec": 30.0}
+    state = StateDict(w=np.arange(8192, dtype=np.float32), step=1)
+    with override_batching_disabled(True):
+        Snapshot.take("gs://bkt/snaps/m0", {"s": state})
+        Snapshot.take(
+            "gs://bkt/snaps/m1",
+            {"s": state},
+            incremental_from="gs://bkt/snaps/m0",
+        )
+    stats = materialize_snapshot("gs://bkt/snaps/m1", storage_options=opts)
+    assert stats["blobs_copied"] == 1
+    # Delete the base server-side; the materialized snapshot stands alone.
+    for k in list(fake_gcs.objects):
+        if "snaps/m0" in k:
+            del fake_gcs.objects[k]
+    assert verify_snapshot("gs://bkt/snaps/m1", storage_options=opts).clean
+    target = StateDict(w=np.zeros(8192, dtype=np.float32), step=0)
+    Snapshot("gs://bkt/snaps/m1", storage_options=opts).restore({"s": target})
+    assert np.array_equal(target["w"], state["w"]) and target["step"] == 1
