@@ -495,3 +495,11 @@ def test_interleaved_communicator_instances():
     run_subprocess_world(
         _world_interleaved_communicators, world_size=2, devices_per_process=1
     )
+
+
+def test_comm_collectives_world16():
+    """The O(1)-RPC comm design at world 16: gathers/broadcasts/barriers
+    complete promptly (serial-RPC designs degrade quadratically here)."""
+    run_subprocess_world(
+        _world_collectives, world_size=16, devices_per_process=1, timeout=480
+    )

@@ -279,3 +279,66 @@ def test_snapshot_handle_reuse_and_close(tmp_path):
     out = snap.read_object("0/m/w0")
     np.testing.assert_array_equal(out, arrs["w0"])
     snap.close()
+
+
+class TestAsyncRestore:
+    def test_round_trip(self, tmp_path):
+        import numpy as np
+
+        from tpusnap import Snapshot, StateDict
+
+        src = StateDict(
+            w=np.random.default_rng(0).standard_normal((512, 64)).astype(np.float32),
+            step=9,
+        )
+        path = str(tmp_path / "s")
+        Snapshot.take(path, {"app": src})
+        target = {"app": StateDict(w=np.zeros((512, 64), np.float32), step=0)}
+        pending = Snapshot(path).async_restore(target)
+        pending.wait()
+        assert pending.done()
+        assert target["app"]["step"] == 9
+        assert np.array_equal(target["app"]["w"], src["w"])
+
+    def test_failure_reraises_from_wait(self, tmp_path):
+        import numpy as np
+        import pytest
+
+        from tpusnap import Snapshot, StateDict
+
+        path = str(tmp_path / "s")
+        Snapshot.take(path, {"app": StateDict(w=np.ones(64, np.float32))})
+        # Corrupt the snapshot's blob so the background read fails.
+        for dirpath, _, files in __import__("os").walk(path):
+            for f in files:
+                if not f.startswith(".snapshot"):
+                    full = __import__("os").path.join(dirpath, f)
+                    with open(full, "r+b") as fh:
+                        b = fh.read(1)
+                        fh.seek(0)
+                        fh.write(bytes([b[0] ^ 0xFF]))
+        target = {"app": StateDict(w=np.zeros(64, np.float32))}
+        pending = Snapshot(path).async_restore(target)
+        with pytest.raises(Exception):
+            pending.wait()
+
+    def test_overlaps_with_other_work(self, tmp_path):
+        """The call returns before the restore completes (the calling
+        thread is free for compilation/data warmup)."""
+        import numpy as np
+
+        from tpusnap import Snapshot, StateDict
+
+        src = StateDict(
+            big=np.random.default_rng(1).standard_normal((4000, 1000)).astype(np.float32)
+        )
+        path = str(tmp_path / "s")
+        Snapshot.take(path, {"app": src})
+        target = {"app": StateDict(big=np.zeros((4000, 1000), np.float32))}
+        pending = Snapshot(path).async_restore(target)
+        # A 16 MB disk read cannot have completed in the microseconds
+        # since the constructor returned: the work is actually
+        # backgrounded, not run inline.
+        assert not pending.done()
+        pending.wait()
+        assert np.array_equal(target["app"]["big"], src["big"])

@@ -16,7 +16,7 @@ try:  # pragma: no cover - import surface grows as modules land
     from .state_dict import StateDict  # noqa: F401
     from .rng_state import RNGState  # noqa: F401
     from .pytree_state import PytreeState  # noqa: F401
-    from .snapshot import PendingSnapshot, Snapshot  # noqa: F401
+    from .snapshot import PendingRestore, PendingSnapshot, Snapshot  # noqa: F401
     from .host_offload import (  # noqa: F401
         is_host_resident,
         supports_host_offload,
@@ -31,6 +31,7 @@ try:  # pragma: no cover - import surface grows as modules land
         "verify_snapshot",
         "Snapshot",
         "PendingSnapshot",
+        "PendingRestore",
         "Stateful",
         "AppState",
         "StateDict",

@@ -286,10 +286,31 @@ class Snapshot:
         with self._op_lock:
             self._restore_locked(app_state, comm, per_key_barrier)
 
-    def _restore_locked(self, app_state, comm, per_key_barrier) -> None:
+    def async_restore(self, app_state: AppState) -> "PendingRestore":
+        """Restore on a background thread; training-adjacent work
+        (compilation, data pipeline warmup) overlaps the storage reads.
+        ``app_state``'s statefuls must not be touched until ``wait()``
+        returns — ``load_state_dict`` runs on the background thread.
+
+        Safe off the main thread because the default restore issues NO
+        collectives: the one cold-start collective (the memory-budget
+        hostname gather) is taken HERE, on the calling thread, before
+        the thread starts. ``per_key_barrier`` restores are inherently
+        collective and have no async form (beyond the reference, which
+        has no async restore either)."""
+        comm = get_communicator(self._comm)
+        _validate_app_state(app_state)
+        # Cold-start collective on the calling thread; cached afterwards.
+        memory_budget = get_process_memory_budget_bytes(comm)
+        return PendingRestore(self, app_state, comm, memory_budget)
+
+    def _restore_locked(
+        self, app_state, comm, per_key_barrier, memory_budget=None
+    ) -> None:
         event_loop, storage = self._resources()
         metadata = self._get_metadata(storage, event_loop)
-        memory_budget = get_process_memory_budget_bytes(comm)
+        if memory_budget is None:
+            memory_budget = get_process_memory_budget_bytes(comm)
 
         multi = comm.world_size > 1
         if per_key_barrier and multi:
@@ -889,7 +910,57 @@ def _load_stateful(
 # ------------------------------------------------------------- async commit
 
 
-class PendingSnapshot:
+class _BackgroundWork:
+    """Shared scaffold for the background-thread handles (async take's
+    commit drain, async restore): daemon thread, exception capture,
+    join-and-reraise. Subclasses implement ``_body`` and optionally
+    ``_on_error`` / ``_cleanup`` (both run on the background thread)."""
+
+    _thread_name = "tpusnap-bg"
+
+    def _start(self) -> None:
+        self._exc: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._thread = threading.Thread(
+            target=self._trampoline, name=self._thread_name, daemon=True
+        )
+        self._thread.start()
+
+    def _trampoline(self) -> None:
+        try:
+            self._body()
+        except BaseException as e:  # noqa: B902 - re-raised from wait()
+            self._exc = e
+            try:
+                self._on_error(e)
+            except Exception:
+                pass
+        finally:
+            try:
+                self._cleanup()
+            except Exception:
+                pass
+            self._done.set()
+
+    def _body(self) -> None:
+        raise NotImplementedError
+
+    def _on_error(self, exc: BaseException) -> None:
+        pass
+
+    def _cleanup(self) -> None:
+        pass
+
+    def _join_and_reraise(self) -> None:
+        self._thread.join()
+        if self._exc is not None:
+            raise self._exc
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class PendingSnapshot(_BackgroundWork):
     """Handle for an in-flight async snapshot (reference snapshot.py:856-944).
 
     A background thread drains storage I/O, then synchronizes the commit
@@ -900,6 +971,7 @@ class PendingSnapshot:
     """
 
     BARRIER_TIMEOUT_SEC = 1800.0  # reference snapshot.py:857
+    _thread_name = "tpusnap-commit"
 
     def __init__(
         self,
@@ -918,8 +990,6 @@ class PendingSnapshot:
         self._comm = comm
         self._event_loop = event_loop
         self._storage_options = storage_options
-        self._exc: Optional[BaseException] = None
-        self._done = threading.Event()
         self._snapshot: Optional[Snapshot] = None
 
         # Barrier identity must be agreed on the MAIN thread (this may
@@ -937,56 +1007,79 @@ class PendingSnapshot:
             world_size=comm.world_size,
             timeout_sec=self.BARRIER_TIMEOUT_SEC,
         )
-        self._thread = threading.Thread(
-            target=self._complete_snapshot, name="tpusnap-commit", daemon=True
-        )
-        self._thread.start()
+        self._start()
 
-    def _complete_snapshot(self) -> None:
+    def _body(self) -> None:
+        self._pending_io_work.sync_complete(self._event_loop)
+        self._barrier.arrive()
+        if self._comm.rank == 0:
+            _write_metadata(self._storage, self._metadata, self._event_loop)
+        self._barrier.depart()
+        # Every rank departing proves it consumed the take's gathers
+        # and the barrier-prefix broadcast; release their KV keys now
+        # — no further barrier will run on this communicator, so the
+        # lazy GC would otherwise never fire (and per-iteration
+        # manifests would accumulate in the coordination service
+        # forever). Bounded by the epoch captured at construction so
+        # a newer take's in-flight keys are never touched. KV deletes
+        # only — still no collectives off the main thread.
         try:
-            self._pending_io_work.sync_complete(self._event_loop)
-            self._barrier.arrive()
-            if self._comm.rank == 0:
-                _write_metadata(self._storage, self._metadata, self._event_loop)
-            self._barrier.depart()
-            # Every rank departing proves it consumed the take's gathers
-            # and the barrier-prefix broadcast; release their KV keys now
-            # — no further barrier will run on this communicator, so the
-            # lazy GC would otherwise never fire (and per-iteration
-            # manifests would accumulate in the coordination service
-            # forever). Bounded by the epoch captured at construction so
-            # a newer take's in-flight keys are never touched. KV deletes
-            # only — still no collectives off the main thread.
-            try:
-                self._comm.gc_consumed_keys(self._gc_epoch)
-            except Exception:
-                pass
-            snapshot = Snapshot(self.path, self._storage_options, self._comm)
-            snapshot._metadata = self._metadata
-            self._snapshot = snapshot
-        except BaseException as e:  # noqa: B902
-            self._exc = e
-            try:
-                self._barrier.report_error(e)
-            except Exception:
-                pass
-        finally:
-            try:
-                self._storage.sync_close(self._event_loop)
-                self._event_loop.close()
-            except Exception:
-                pass
-            self._done.set()
+            self._comm.gc_consumed_keys(self._gc_epoch)
+        except Exception:
+            pass
+        snapshot = Snapshot(self.path, self._storage_options, self._comm)
+        snapshot._metadata = self._metadata
+        self._snapshot = snapshot
+
+    def _on_error(self, exc: BaseException) -> None:
+        # Poison the barrier so every rank's wait() re-raises and the
+        # metadata is never written.
+        self._barrier.report_error(exc)
+
+    def _cleanup(self) -> None:
+        self._storage.sync_close(self._event_loop)
+        self._event_loop.close()
 
     def wait(self) -> Snapshot:
-        self._thread.join()
-        if self._exc is not None:
-            raise self._exc
+        self._join_and_reraise()
         assert self._snapshot is not None
         return self._snapshot
 
-    def done(self) -> bool:
-        return self._done.is_set()
+
+class PendingRestore(_BackgroundWork):
+    """Handle for an in-flight background restore (``async_restore``).
+
+    ``wait()`` joins the thread and re-raises any failure; the restored
+    ``app_state`` must not be read before it returns. The snapshot
+    handle's ``_op_lock`` serializes against concurrent
+    restore/read_object/verify calls on the same handle."""
+
+    _thread_name = "tpusnap-restore"
+
+    def __init__(
+        self,
+        snapshot: Snapshot,
+        app_state: AppState,
+        comm: Communicator,
+        memory_budget: int,
+    ) -> None:
+        self._snapshot = snapshot
+        self._app_state = app_state
+        self._comm = comm
+        self._memory_budget = memory_budget
+        self._start()
+
+    def _body(self) -> None:
+        with self._snapshot._op_lock:
+            self._snapshot._restore_locked(
+                self._app_state,
+                self._comm,
+                per_key_barrier=False,
+                memory_budget=self._memory_budget,
+            )
+
+    def wait(self) -> None:
+        self._join_and_reraise()
 
 
 def _get_kv_store(comm: Communicator) -> KVStore:
