@@ -1,0 +1,111 @@
+"""async_take under high-latency storage: blocked time vs total time.
+
+On a fast local disk, staging and I/O finish together, so async_take's
+advantage is invisible (benchmarks/embedding measures that case). This
+harness injects a fixed per-request latency into the fs plugin — the
+cloud-storage shape, ~50 ms RTT per object — WITHOUT disk-bandwidth
+noise, and reports the split the reference's torchrec benchmark reports
+(benchmarks/torchrec/main.py:133-151):
+
+- sync take: training blocked for the WHOLE wall time;
+- async take: blocked only for staging (+ the latency the scheduler
+  cannot hide); storage I/O drains behind training.
+
+Run: python benchmarks/async_latency/main.py [--latency-ms 50] [--mb 256]
+"""
+
+import argparse
+import asyncio
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--latency-ms", type=float, default=50.0)
+    parser.add_argument("--mb", type=float, default=256.0)
+    parser.add_argument("--runs", type=int, default=3)
+    args = parser.parse_args()
+
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict
+    from tpusnap.storage_plugin import (
+        register_storage_plugin,
+        unregister_storage_plugin,
+    )
+    from tpusnap.storage_plugins.fs import FSStoragePlugin
+
+    latency = args.latency_ms / 1e3
+
+    class HighLatencyFS(FSStoragePlugin):
+        """Local fs with a fixed per-request latency — the cloud-object-
+        store shape, minus bandwidth noise."""
+
+        async def write(self, write_io):
+            await asyncio.sleep(latency)
+            await super().write(write_io)
+
+        async def read(self, read_io):
+            await asyncio.sleep(latency)
+            await super().read(read_io)
+
+    register_storage_plugin("slowfs", lambda path, opts: HighLatencyFS(path, opts))
+    root = tempfile.mkdtemp(prefix="tpusnap_async_lat_")
+    try:
+        rng = np.random.default_rng(0)
+        n_arrays = 16
+        per = int(args.mb * 1024**2) // n_arrays
+        state = StateDict(
+            **{
+                f"w{i}": rng.standard_normal(per // 4).astype(np.float32)
+                for i in range(n_arrays)
+            }
+        )
+        nbytes = sum(a.nbytes for a in state.values())
+        print(
+            f"{nbytes / 1e6:.0f} MB over {n_arrays} blobs, "
+            f"+{args.latency_ms:.0f} ms per storage request"
+        )
+
+        sync_times, blocked_times, total_times = [], [], []
+        for run in range(args.runs):
+            t0 = time.perf_counter()
+            Snapshot.take(f"slowfs://{root}/sync{run}", {"app": state})
+            sync_times.append(time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            pending = Snapshot.async_take(
+                f"slowfs://{root}/async{run}", {"app": state}
+            )
+            blocked_times.append(time.perf_counter() - t0)
+            pending.wait()
+            total_times.append(time.perf_counter() - t0)
+
+        sync_t = min(sync_times)
+        blocked = min(blocked_times)
+        total = min(total_times)
+        print(
+            f"sync take:   {sync_t:.2f}s blocked (100% of the snapshot) "
+            f"runs={[round(t, 2) for t in sync_times]}"
+        )
+        print(
+            f"async take:  {blocked:.2f}s blocked / {total:.2f}s total "
+            f"(training stalls {100 * blocked / total:.0f}% of the snapshot; "
+            f"{sync_t / blocked:.1f}x less than sync) "
+            f"blocked_runs={[round(t, 2) for t in blocked_times]}"
+        )
+    finally:
+        unregister_storage_plugin("slowfs")
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
