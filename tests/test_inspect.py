@@ -271,3 +271,83 @@ def test_scrub_concurrency_knob(tmp_path, monkeypatch):
     assert verify_snapshot(path).clean
     monkeypatch.setenv("TPUSNAP_SCRUB_CONCURRENCY", "16")
     assert verify_snapshot(path).clean
+
+
+def test_diff_snapshots(tmp_path, capsys):
+    """Manifest-only diff: identical/changed/added/removed classification
+    across batching modes and incremental references (content identity is
+    location-independent — a slab-repacked or base-referenced blob with
+    the same bytes diffs as identical)."""
+    from tpusnap.__main__ import main as cli_main
+    from tpusnap.inspect import diff_snapshots
+
+    st = _state()
+    a = str(tmp_path / "a")
+    with override_batching_disabled(True):
+        Snapshot.take(a, {"app": st})
+
+    # Same content, different physical layout: batching ON + incremental.
+    b = str(tmp_path / "b")
+    Snapshot.take(b, {"app": st}, incremental_from=a)
+    d = diff_snapshots(a, b)
+    assert d.same, d.summary()
+
+    # Change one value, drop one key, add one key.
+    st2 = _state()
+    st2["dense"] = st2["dense"] + 1.0
+    del st2["small"]
+    st2["extra"] = np.ones(8, np.float32)
+    c = str(tmp_path / "c")
+    with override_batching_disabled(True):
+        Snapshot.take(c, {"app": st2})
+    d = diff_snapshots(a, c)
+    assert "0/app/dense" in d.changed
+    assert "0/app/small" in d.removed
+    assert "0/app/extra" in d.added
+    assert not d.same
+
+    assert cli_main(["diff", a, b]) == 0
+    assert "0 changed" in capsys.readouterr().out
+    assert cli_main(["diff", "-q", a, c]) == 2
+    out = capsys.readouterr().out
+    assert "1 changed, 1 added, 1 removed" in out
+
+
+def test_diff_undecidable_cases(tmp_path, capsys):
+    """Checksum-less snapshots and incomparable layouts are 'undecidable'
+    (exit 3), never claimed identical or different."""
+    from tpusnap.__main__ import main as cli_main
+    from tpusnap.inspect import diff_snapshots
+    from tpusnap.knobs import (
+        override_checksum_disabled,
+        override_max_chunk_size_bytes,
+    )
+
+    st = _state()
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    with override_checksum_disabled(True):
+        Snapshot.take(a, {"app": st})
+        Snapshot.take(b, {"app": st})
+    d = diff_snapshots(a, b)
+    assert not d.same and not d.differs and d.unknown
+    assert cli_main(["diff", "-q", a, b]) == 3
+    capsys.readouterr()
+
+    # Same bytes, different chunk geometry: undecidable, not changed.
+    big = np.random.default_rng(0).standard_normal((64, 64)).astype(np.float32)
+    c1, c2 = str(tmp_path / "c1"), str(tmp_path / "c2")
+    with override_batching_disabled(True):
+        with override_max_chunk_size_bytes(4 * 1024):
+            Snapshot.take(c1, {"app": StateDict(big=big)})
+        with override_max_chunk_size_bytes(2 * 1024):
+            Snapshot.take(c2, {"app": StateDict(big=big)})
+    d = diff_snapshots(c1, c2)
+    assert "0/app/big" in d.unknown and not d.differs
+
+    # Different dtype at the same path: provably changed even across
+    # layouts.
+    c3 = str(tmp_path / "c3")
+    with override_batching_disabled(True):
+        Snapshot.take(c3, {"app": StateDict(big=big.astype(np.float64))})
+    d = diff_snapshots(c1, c3)
+    assert "0/app/big" in d.changed

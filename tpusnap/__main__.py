@@ -9,6 +9,9 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
   cat         PATH MANIFEST_PATH  read one object (``read_object``), print it
   materialize PATH      copy base-referenced blobs into an incremental
                         snapshot so its bases can be deleted
+  diff        A B       compare two snapshots by recorded checksums only
+                        (no data reads; exit 2 = provably different,
+                        3 = undecidable without reading data)
 
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found.
 """
@@ -132,6 +135,28 @@ def cmd_materialize(args) -> int:
     return 0
 
 
+def cmd_diff(args) -> int:
+    from .inspect import diff_snapshots
+
+    d = diff_snapshots(args.path_a, args.path_b)
+    if not args.quiet:
+        for tag, paths in (
+            ("~", d.changed),
+            ("+", d.added),
+            ("-", d.removed),
+            ("?", d.unknown),
+        ):
+            for p in paths:
+                print(f"{tag} {p}")
+    print(d.summary())
+    # 0 = provably identical, 2 = provably different, 3 = undecidable
+    # (missing checksums / incomparable layouts) — so scripts can't
+    # mistake "couldn't compare" for either verdict.
+    if d.differs:
+        return 2
+    return 0 if d.same else 3
+
+
 def cmd_cat(args) -> int:
     out = Snapshot(args.path).read_object(args.manifest_path)
     if isinstance(out, np.ndarray):
@@ -175,6 +200,17 @@ def main(argv=None) -> int:
     )
     p.add_argument("path")
     p.set_defaults(fn=cmd_materialize)
+
+    p = sub.add_parser(
+        "diff",
+        help="compare two snapshots by recorded checksums (no data reads)",
+    )
+    p.add_argument("path_a")
+    p.add_argument("path_b")
+    p.add_argument(
+        "-q", "--quiet", action="store_true", help="summary line only"
+    )
+    p.set_defaults(fn=cmd_diff)
 
     try:
         args = parser.parse_args(argv)

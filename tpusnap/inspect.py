@@ -39,7 +39,9 @@ from .serialization import tensor_nbytes
 __all__ = [
     "BlobCheck",
     "ScrubReport",
+    "SnapshotDiff",
     "base_root_of_location",
+    "diff_snapshots",
     "entry_nbytes",
     "entry_verifiable",
     "iter_blobs",
@@ -273,13 +275,7 @@ def materialize_snapshot(
                 path, event_loop, storage_options
             )
         try:
-            from .snapshot import SNAPSHOT_METADATA_FNAME
-
-            read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
-            storage.sync_read(read_io, event_loop)
-            metadata = SnapshotMetadata.from_yaml(
-                read_io.buf.getvalue().decode("utf-8")
-            )
+            metadata = _read_metadata(storage, event_loop, path)
 
             # Map each distinct external location to its local home: the
             # blob's path within its base snapshot (unique — locations
@@ -343,6 +339,8 @@ def materialize_snapshot(
                     f"base: {detail}"
                 )
 
+            from .snapshot import SNAPSHOT_METADATA_FNAME
+
             storage.sync_write_atomic(
                 WriteIO(
                     path=SNAPSHOT_METADATA_FNAME,
@@ -357,6 +355,156 @@ def materialize_snapshot(
         if owns_resources:
             event_loop.close()
     return {"blobs_copied": len(local_for), "bytes_copied": bytes_copied}
+
+
+@dataclass
+class SnapshotDiff:
+    """Manifest-level diff of two snapshots (by recorded checksums — no
+    data is read). Paths are logical (``rank/...``)."""
+
+    added: List[str] = field(default_factory=list)
+    removed: List[str] = field(default_factory=list)
+    changed: List[str] = field(default_factory=list)  # provably different
+    identical: List[str] = field(default_factory=list)
+    # Undecidable without reading data: a side lacks checksums, or the
+    # two snapshots stored the same-typed value in incomparable layouts
+    # (different chunk/shard geometry, dense vs chunked).
+    unknown: List[str] = field(default_factory=list)
+
+    @property
+    def same(self) -> bool:
+        """Provably identical: every path matched by checksum."""
+        return not (self.added or self.removed or self.changed or self.unknown)
+
+    @property
+    def differs(self) -> bool:
+        """Provably different (unknown entries do not count)."""
+        return bool(self.added or self.removed or self.changed)
+
+    def summary(self) -> str:
+        return (
+            f"{len(self.identical)} identical, {len(self.changed)} changed, "
+            f"{len(self.added)} added, {len(self.removed)} removed"
+            + (f", {len(self.unknown)} undecidable" if self.unknown else "")
+        )
+
+
+def _entry_fingerprint(entry: Entry):
+    """(identity, geometry, content) of a leaf entry.
+
+    - ``identity``: what the value IS (dtype/shape or object type) — an
+      identity mismatch is a real change regardless of layout.
+    - ``geometry``: how it was stored (dense/chunked/sharded + boxes) —
+      checksums are only comparable between equal geometries.
+    - ``content``: the recorded checksums, or None when absent.
+
+    Locations are excluded throughout — a blob that moved (slab
+    repacking, incremental reference) but hashes identically is the
+    same content."""
+    if isinstance(entry, PrimitiveEntry):
+        return (("prim", entry.dtype), (), entry.serialized_value)
+    if isinstance(entry, TensorEntry):
+        return (
+            ("tensor", entry.dtype, tuple(entry.shape)),
+            ("dense",),
+            entry.checksum,
+        )
+    if isinstance(entry, ChunkedTensorEntry):
+        parts = tuple(c.tensor.checksum for c in entry.chunks)
+        return (
+            ("tensor", entry.dtype, tuple(entry.shape)),
+            ("chunked", tuple((tuple(c.offsets), tuple(c.sizes)) for c in entry.chunks)),
+            None if any(p is None for p in parts) else parts,
+        )
+    if isinstance(entry, ShardedEntry):
+        shards = sorted(
+            entry.shards, key=lambda s: (tuple(s.offsets), tuple(s.sizes))
+        )
+        parts = tuple(s.tensor.checksum for s in shards)
+        return (
+            ("tensor", entry.dtype, tuple(entry.shape)),
+            ("sharded", tuple((tuple(s.offsets), tuple(s.sizes)) for s in shards)),
+            None if any(p is None for p in parts) else parts,
+        )
+    if isinstance(entry, ObjectEntry):
+        return (("object", entry.obj_type), (), entry.checksum)
+    return (("?", type(entry).__name__), (), None)
+
+
+def _read_metadata(
+    storage: StoragePlugin,
+    event_loop: asyncio.AbstractEventLoop,
+    path: str,
+) -> SnapshotMetadata:
+    """Read + parse ``.snapshot_metadata`` through an existing plugin
+    (the one shared metadata-loading block for scrub/materialize/diff)."""
+    from .snapshot import SNAPSHOT_METADATA_FNAME
+
+    read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+    try:
+        storage.sync_read(read_io, event_loop)
+    except Exception as e:
+        raise RuntimeError(
+            f"Failed to read snapshot metadata at {path} — not a "
+            "snapshot, or an aborted/incomplete one"
+        ) from e
+    return SnapshotMetadata.from_yaml(read_io.buf.getvalue().decode("utf-8"))
+
+
+def load_snapshot_metadata(
+    path: str,
+    storage_options: Optional[Dict[str, Any]] = None,
+) -> SnapshotMetadata:
+    """Read and parse a snapshot's ``.snapshot_metadata`` standalone
+    (own short-lived event loop + plugin)."""
+    from .storage_plugin import url_to_storage_plugin_in_event_loop
+
+    loop = asyncio.new_event_loop()
+    try:
+        storage = url_to_storage_plugin_in_event_loop(
+            path, loop, storage_options
+        )
+        try:
+            return _read_metadata(storage, loop, path)
+        finally:
+            storage.sync_close(loop)
+    finally:
+        loop.close()
+
+
+def diff_snapshots(
+    path_a: str,
+    path_b: str,
+    storage_options: Optional[Dict[str, Any]] = None,
+) -> SnapshotDiff:
+    """Compare two snapshots entry-by-entry using only their manifests'
+    recorded checksums — O(metadata), no blob reads. ``changed`` means
+    the content provably differs (identity mismatch, or equal layouts
+    with different checksums); ``unknown`` means equality cannot be
+    decided cheaply (missing checksums, or same-typed values stored in
+    incomparable chunk/shard geometries)."""
+    ma = load_snapshot_metadata(path_a, storage_options).manifest
+    mb = load_snapshot_metadata(path_b, storage_options).manifest
+    leaves_a = {p: e for p, e in ma.items() if not is_container_entry(e)}
+    leaves_b = {p: e for p, e in mb.items() if not is_container_entry(e)}
+    out = SnapshotDiff()
+    for p in sorted(set(leaves_a) | set(leaves_b)):
+        if p not in leaves_b:
+            out.removed.append(p)
+        elif p not in leaves_a:
+            out.added.append(p)
+        else:
+            ia, ga, ca = _entry_fingerprint(leaves_a[p])
+            ib, gb, cb = _entry_fingerprint(leaves_b[p])
+            if ia != ib:
+                out.changed.append(p)  # different dtype/shape/type
+            elif ca is None or cb is None or ga != gb:
+                out.unknown.append(p)
+            elif ca == cb:
+                out.identical.append(p)
+            else:
+                out.changed.append(p)
+    return out
 
 
 async def _verify_one(
@@ -504,13 +652,7 @@ def verify_snapshot(
             )
         try:
             if metadata is None:
-                from .snapshot import SNAPSHOT_METADATA_FNAME
-
-                read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
-                storage.sync_read(read_io, event_loop)
-                metadata = SnapshotMetadata.from_yaml(
-                    read_io.buf.getvalue().decode("utf-8")
-                )
+                metadata = _read_metadata(storage, event_loop, path)
             checks = _run_verifications(
                 storage, event_loop, list(iter_blobs(metadata.manifest))
             )
