@@ -342,3 +342,37 @@ class TestAsyncRestore:
         assert not pending.done()
         pending.wait()
         assert np.array_equal(target["app"]["big"], src["big"])
+
+
+class TestCastOnSave:
+    def test_glob_cast_and_passthrough(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpusnap import Snapshot, StateDict
+        from tpusnap.transforms import cast_on_save
+
+        st = StateDict(
+            kernel=np.random.default_rng(0).standard_normal((64, 32)).astype(np.float32),
+            step_count=np.arange(8, dtype=np.int32),
+        )
+        path = str(tmp_path / "s")
+        Snapshot.take(
+            path,
+            {"m": st},
+            _custom_array_prepare_func=cast_on_save({"m/kernel": jnp.bfloat16}),
+        )
+        md = Snapshot(path).metadata
+        assert md.manifest["0/m/kernel"].dtype == "bfloat16"
+        assert md.manifest["0/m/step_count"].dtype == "int32"  # passthrough
+        # Restore: stored bf16 lands in a bf16 target bit-exactly.
+        import ml_dtypes
+
+        target = {"m": StateDict(
+            kernel=np.zeros((64, 32), dtype=ml_dtypes.bfloat16),
+            step_count=np.zeros(8, np.int32),
+        )}
+        Snapshot(path).restore(target)
+        expect = st["kernel"].astype(ml_dtypes.bfloat16)
+        assert target["m"]["kernel"].tobytes() == expect.tobytes()
+        assert np.array_equal(target["m"]["step_count"], st["step_count"])

@@ -32,7 +32,7 @@ import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Awaitable, List, Optional, Set
+from typing import List, Optional, Set
 
 import psutil
 

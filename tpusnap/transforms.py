@@ -1,0 +1,46 @@
+"""Ready-made save-time array transforms for ``_custom_array_prepare_func``.
+
+The hook (reference ``_custom_tensor_prepare_func``,
+/root/reference/torchsnapshot/snapshot.py:170-196) is powerful but raw:
+a callable of ``(logical_path, arr, tracing)``. These helpers build the
+common cases so users don't hand-roll glob matching:
+
+    from tpusnap.transforms import cast_on_save
+
+    Snapshot.take(
+        path, app_state,
+        _custom_array_prepare_func=cast_on_save({"**/params/**": jnp.bfloat16}),
+    )
+
+Transforms run under ``jax.eval_shape`` at prepare time (so they must be
+traceable — ``astype`` is) and for real at stage time.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Callable, Dict
+
+__all__ = ["cast_on_save"]
+
+
+def cast_on_save(
+    dtype_by_glob: Dict[str, Any],
+) -> Callable[[str, Any, bool], Any]:
+    """Save-time dtype cast by logical-path glob — checkpoint at reduced
+    precision (e.g. bf16 weights) while training at full precision.
+
+    ``dtype_by_glob`` maps glob patterns (matched against the flattened
+    logical path, e.g. ``"model/params/dense/kernel"``) to target
+    dtypes; first match wins, unmatched arrays pass through unchanged.
+    Restore honors the stored dtype — restoring into a full-precision
+    target upcasts via the target's dtype/sharding as usual."""
+    patterns = list(dtype_by_glob.items())
+
+    def transform(logical_path: str, arr: Any, tracing: bool) -> Any:
+        for pattern, dtype in patterns:
+            if fnmatch.fnmatch(logical_path, pattern):
+                return arr.astype(dtype)
+        return arr
+
+    return transform
