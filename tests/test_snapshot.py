@@ -215,14 +215,18 @@ class TestCustomArrayPrepareFunc:
         assert manifest["0/m/w"].dtype == "bfloat16"
         assert manifest["0/m/b"].dtype == "float32"
 
-        # Restore honors the stored dtype: the value comes back bf16
-        # (precision loss is the user's explicit choice).
+        # The entry dtype is honored on read (bytes deserialize as bf16 —
+        # the precision loss proves it), then cast INTO the target's
+        # dtype like the reference's tensor_copy (tensor.py:383-403):
+        # an f32 training target receives the bf16-rounded values upcast.
+        import ml_dtypes
+
         target = {"m": StateDict(w=np.zeros_like(w), b=np.zeros_like(b))}
         Snapshot(str(tmp_path / "s")).restore(target)
         restored_w = target["m"]["w"]
-        assert str(np.asarray(restored_w).dtype) == "bfloat16"
-        np.testing.assert_allclose(
-            np.asarray(restored_w, dtype=np.float32), w, atol=0.02
+        assert restored_w.dtype == np.float32
+        np.testing.assert_array_equal(
+            restored_w, w.astype(ml_dtypes.bfloat16).astype(np.float32)
         )
         np.testing.assert_array_equal(target["m"]["b"], b)
 
@@ -376,3 +380,61 @@ class TestCastOnSave:
         expect = st["kernel"].astype(ml_dtypes.bfloat16)
         assert target["m"]["kernel"].tobytes() == expect.tobytes()
         assert np.array_equal(target["m"]["step_count"], st["step_count"])
+
+
+class TestDtypeCastOnRestore:
+    """A blob stored at reduced precision restores INTO a full-precision
+    target upcast (the reference's tensor_copy casts into the target,
+    io_preparers/tensor.py:383-403) — and vice versa; exact-dtype
+    targets stay byte-exact in-place."""
+
+    def _take_bf16(self, tmp_path):
+        import jax.numpy as jnp
+
+        from tpusnap.transforms import cast_on_save
+
+        w = np.linspace(-2, 2, 4096).astype(np.float32).reshape(64, 64)
+        path = str(tmp_path / "s")
+        Snapshot.take(
+            path,
+            {"m": StateDict(w=w)},
+            _custom_array_prepare_func=cast_on_save({"m/w": jnp.bfloat16}),
+        )
+        return path, w
+
+    def test_upcast_into_f32_targets(self, tmp_path):
+        import ml_dtypes
+
+        path, w = self._take_bf16(tmp_path)
+        expect = w.astype(ml_dtypes.bfloat16).astype(np.float32)
+
+        tgt_np = {"m": StateDict(w=np.zeros((64, 64), np.float32))}
+        Snapshot(path).restore(tgt_np)
+        assert tgt_np["m"]["w"].dtype == np.float32
+        assert np.array_equal(tgt_np["m"]["w"], expect)
+
+        tgt_jax = {"m": StateDict(w=jnp.zeros((64, 64), jnp.float32))}
+        Snapshot(path).restore(tgt_jax)
+        assert tgt_jax["m"]["w"].dtype == jnp.float32
+        assert np.array_equal(np.asarray(tgt_jax["m"]["w"]), expect)
+
+    def test_upcast_under_memory_budget(self, tmp_path):
+        """Tiled reads (mismatched-dtype target -> fresh host buffer)
+        cast at completion too."""
+        import ml_dtypes
+
+        path, w = self._take_bf16(tmp_path)
+        out = Snapshot(path).read_object(
+            "0/m/w",
+            obj_out=np.zeros((64, 64), np.float32),
+            memory_budget_bytes=2048,
+        )
+        assert out.dtype == np.float32
+        assert np.array_equal(out, w.astype(ml_dtypes.bfloat16).astype(np.float32))
+
+    def test_no_target_keeps_stored_dtype(self, tmp_path):
+        import ml_dtypes
+
+        path, w = self._take_bf16(tmp_path)
+        out = Snapshot(path).read_object("0/m/w")
+        assert out.dtype == ml_dtypes.bfloat16

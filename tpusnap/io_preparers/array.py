@@ -475,26 +475,47 @@ def _owning_copy(src: np.ndarray) -> np.ndarray:
     return out
 
 
+def finalize_into_target(
+    host: np.ndarray, obj_out: Optional[ArrayLike], owns_memory: bool
+) -> ArrayLike:
+    """Land a deserialized host array in the restore target — the ONE
+    implementation of the cast-into-target semantics (the reference's
+    tensor_copy, io_preparers/tensor.py:383-403) shared by the
+    whole-blob and tiled/chunked paths:
+
+    - same-shape writable numpy target: filled IN PLACE, cast to the
+      target's dtype when it differs (a bf16-saved blob restores into an
+      f32 training target upcast);
+    - jax target: ``device_put`` with the target's sharding at the
+      STORED dtype (half the HtoD bytes for reduced-precision saves),
+      then cast ON DEVICE to the target's dtype when it differs;
+    - otherwise: a host array owning its memory (``owns_memory`` says
+      whether ``host`` already does, or aliases a transient read
+      buffer)."""
+    if isinstance(obj_out, np.ndarray):
+        if obj_out.shape == host.shape and obj_out.flags.writeable:
+            np.copyto(obj_out, host, casting="unsafe")
+            return obj_out
+        return host if owns_memory else _owning_copy(host)
+    if isinstance(obj_out, jax.Array):
+        # device_put is async; XLA overlaps the HtoD DMA with further
+        # reads. The dtype cast (if any) runs on the accelerator with
+        # the sharding preserved — not as a host pass that would double
+        # the transfer volume.
+        dev = jax.device_put(host, obj_out.sharding)
+        if obj_out.dtype != dev.dtype and obj_out.shape == dev.shape:
+            dev = dev.astype(obj_out.dtype)
+        return dev
+    return host if owns_memory else _owning_copy(host)
+
+
 def materialize_array(
     entry: TensorEntry, buf: BufferType, obj_out: Optional[ArrayLike]
 ) -> ArrayLike:
     src = array_from_memoryview(memoryview(buf), entry.dtype, entry.shape)
-    if isinstance(obj_out, np.ndarray):
-        if (
-            obj_out.dtype == src.dtype
-            and obj_out.shape == src.shape
-            and obj_out.flags.writeable
-        ):
-            np.copyto(obj_out, src)
-            return obj_out
-        return _owning_copy(src)
-    if isinstance(obj_out, jax.Array):
-        # Restore with the target's sharding/placement. device_put is async;
-        # XLA overlaps the HtoD DMA with subsequent reads.
-        return jax.device_put(src, obj_out.sharding)
-    # No target: plain host array (owns its memory — `src` aliases the
-    # read buffer which is about to be released).
-    return _owning_copy(src)
+    # `src` aliases the read buffer (about to be released) — any bare
+    # return must copy.
+    return finalize_into_target(src, obj_out, owns_memory=False)
 
 
 def trace_array_prepare(
@@ -753,7 +774,7 @@ class _TileConsumer(BufferConsumer):
         else:
             await self.consume_buffer(read_io.buf.getbuffer(), executor)
             return
-        self._after_consume()
+        await self._after_consume(executor)
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -763,20 +784,35 @@ class _TileConsumer(BufferConsumer):
             await loop.run_in_executor(executor, self._consume_blocking, buf)
         else:
             self._consume_blocking(buf)
-        self._after_consume()
+        await self._after_consume(executor)
 
-    def _after_consume(self) -> None:
+    async def _after_consume(self, executor: Optional[Executor] = None) -> None:
         # Completion bookkeeping stays on the event-loop thread — the
         # executor runs up to 4 consumers concurrently and a bare
         # read-modify-write there can lose decrements.
         self.remaining["count"] -= 1
-        if self.remaining["count"] == 0:
-            if self.in_place:
-                self.fut.obj = self.host_out
-            elif isinstance(self.obj_out, jax.Array):
-                self.fut.obj = jax.device_put(self.host_out, self.obj_out.sharding)
-            else:
-                self.fut.obj = self.host_out
+        if self.remaining["count"] != 0:
+            return
+        if self.in_place:
+            # host_out IS the caller's target; bytes already landed.
+            self.fut.obj = self.host_out
+            return
+        # Finalization may be a full data pass (cast into a
+        # mismatched-dtype target) — run it in the executor so the
+        # event loop keeps dispatching other entries' reads.
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            self.fut.obj = await loop.run_in_executor(
+                executor,
+                finalize_into_target,
+                self.host_out,
+                self.obj_out,
+                True,
+            )
+        else:
+            self.fut.obj = finalize_into_target(
+                self.host_out, self.obj_out, True
+            )
 
     def _consume_blocking(self, buf: BufferType) -> None:
         _maybe_verify(buf, self.blob_checksum, self.blob_location)

@@ -33,8 +33,13 @@ def cast_on_save(
     ``dtype_by_glob`` maps glob patterns (matched against the flattened
     logical path, e.g. ``"model/params/dense/kernel"``) to target
     dtypes; first match wins, unmatched arrays pass through unchanged.
-    Restore honors the stored dtype — restoring into a full-precision
-    target upcasts via the target's dtype/sharding as usual."""
+    Restoring into a full-precision target upcasts into the target's
+    dtype (on device for jax targets).
+
+    Applies to DENSE and CHUNKED arrays only: sharded (multi-device
+    ``NamedSharding``) arrays are written shard-by-shard untransformed
+    — cast those before snapshotting (e.g. keep a bf16 eval copy) if
+    reduced-precision sharded checkpoints are needed."""
     patterns = list(dtype_by_glob.items())
 
     def transform(logical_path: str, arr: Any, tracing: bool) -> Any:
