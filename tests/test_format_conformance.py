@@ -1,0 +1,182 @@
+"""docs/format.md conformance: a third-party reader using ONLY the
+documented on-disk format (json + raw file reads + numpy/ml_dtypes —
+none of tpusnap's read machinery) must be able to reconstruct every
+array class a snapshot stores: dense, slab member, sharded, chunked,
+primitive, and incremental '../' references.
+
+This is the proof that the format spec is the actual contract, not
+aspirational documentation.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusnap import Snapshot, StateDict, PytreeState
+from tpusnap.knobs import (
+    override_batching_disabled,
+    override_max_chunk_size_bytes,
+)
+
+import ml_dtypes
+
+_DTYPES = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "bfloat16": ml_dtypes.bfloat16,
+    "int32": np.int32,
+    "uint16": np.uint16,
+}
+
+
+def _read_blob(root: str, location: str, byte_range=None) -> bytes:
+    """Raw blob read per the spec: location resolved against the root
+    with POSIX normpath (incremental '../' references), optional
+    [start, end) byte range."""
+    path = os.path.normpath(os.path.join(root, location))
+    with open(path, "rb") as f:
+        data = f.read()
+    if byte_range is not None:
+        data = data[byte_range[0] : byte_range[1]]
+    return data
+
+
+def _tensor_from_entry(root: str, e: dict) -> np.ndarray:
+    data = _read_blob(root, e["location"], e.get("byte_range"))
+    # Verify per spec: crc32c is the native Castagnoli; a zlib-crc32
+    # algo (fallback build) would be skipped — this suite runs native.
+    algo, _, value = e["checksum"].partition(":")
+    if algo == "crc32c":
+        from tpusnap import _native
+
+        assert _native.crc32c(data) == int(value, 16), e["location"]
+    arr = np.frombuffer(data, dtype=_DTYPES[e["dtype"]])
+    return arr.reshape(e["shape"])
+
+
+def _external_reader(root: str):
+    md = json.load(open(os.path.join(root, ".snapshot_metadata")))
+    assert set(md) == {"version", "world_size", "manifest"}
+
+    def read(path: str):
+        e = md["manifest"][path]
+        if e["type"] == "primitive":
+            if e["dtype"] == "float":
+                import base64
+                import struct
+
+                return struct.unpack(
+                    "<d", base64.b64decode(e["serialized_value"])
+                )[0]
+            if e["dtype"] == "int":
+                return int(e["serialized_value"])
+            return e["serialized_value"]
+        if e["type"] == "Tensor":
+            return _tensor_from_entry(root, e)
+        if e["type"] == "ChunkedTensor":
+            out = np.empty(e["shape"], dtype=_DTYPES[e["dtype"]])
+            for c in e["chunks"]:
+                r0 = c["offsets"][0]
+                out[r0 : r0 + c["sizes"][0]] = _tensor_from_entry(
+                    root, c["tensor"]
+                )
+            return out
+        if e["type"] == "Sharded":
+            out = np.empty(e["shape"], dtype=_DTYPES[e["dtype"]])
+            for s in e["shards"]:
+                idx = tuple(
+                    slice(o, o + n) for o, n in zip(s["offsets"], s["sizes"])
+                )
+                out[idx] = _tensor_from_entry(root, s["tensor"])
+            return out
+        raise AssertionError(f"unhandled entry type {e['type']}")
+
+    return md, read
+
+
+def test_external_reader_reconstructs_everything(tmp_path):
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("x", "y"))
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x", "y"))
+    rng = np.random.default_rng(0)
+    dense = rng.standard_normal((128, 64)).astype(np.float32)
+    small_a = np.arange(64, dtype=np.float32)  # slab members
+    small_b = np.arange(64, 128, dtype=np.float32)
+    bf = rng.standard_normal((16, 16)).astype(ml_dtypes.bfloat16)
+    sharded = jax.device_put(
+        jnp.arange(32 * 32, dtype=jnp.float32).reshape(32, 32), sh
+    )
+    chunky = rng.standard_normal((64, 32)).astype(np.float32)
+
+    path = str(tmp_path / "snap")
+    with override_max_chunk_size_bytes(2048):
+        Snapshot.take(
+            path,
+            {
+                "m": PytreeState({"w": sharded}),
+                "t": StateDict(
+                    dense=dense,
+                    a=small_a,
+                    b=small_b,
+                    bf=bf,
+                    chunky=chunky,
+                    step=7,
+                    lr=2.5,
+                    tag="hello",
+                ),
+            },
+        )
+
+    md, read = _external_reader(path)
+    assert md["world_size"] == 1
+    assert np.array_equal(read("0/t/dense"), dense)
+    assert np.array_equal(read("0/t/a"), small_a)  # slab byte_range
+    assert np.array_equal(read("0/t/b"), small_b)
+    assert read("0/t/bf").tobytes() == bf.tobytes()
+    assert np.array_equal(read("0/t/chunky"), chunky)  # chunk reassembly
+    assert np.array_equal(read("0/m/w"), np.asarray(sharded))  # shard scatter
+    assert read("0/t/step") == 7
+    assert read("0/t/lr") == 2.5
+    assert read("0/t/tag") == "hello"
+
+
+def test_external_reader_follows_incremental_references(tmp_path):
+    st = StateDict(w=np.random.default_rng(1).standard_normal((256, 16)).astype(np.float32))
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    with override_batching_disabled(True):
+        Snapshot.take(base, {"app": st})
+        Snapshot.take(inc, {"app": st}, incremental_from=base)
+    md, read = _external_reader(inc)
+    e = md["manifest"]["0/app/w"]
+    assert e["location"].startswith("../"), e["location"]
+    assert np.array_equal(read("0/app/w"), st["w"])
+
+
+def test_tile_checksums_fold_per_spec(tmp_path):
+    """tile_checksums: whole-blob value equals the CRC-combine fold of
+    the per-tile values (spec's sub-range verification contract)."""
+    from tpusnap import _native
+    from tpusnap.knobs import override_tile_checksum_bytes
+
+    arr = np.random.default_rng(2).standard_normal((4096, 16)).astype(np.float32)
+    path = str(tmp_path / "snap")
+    with override_tile_checksum_bytes(64 * 1024), override_batching_disabled(True):
+        Snapshot.take(path, {"app": StateDict(big=arr)})
+    e = json.load(open(os.path.join(path, ".snapshot_metadata")))["manifest"][
+        "0/app/big"
+    ]
+    tiles = e["tile_checksums"]
+    assert len(tiles) > 1
+    row_nbytes = arr.nbytes // arr.shape[0]
+    t = e["tile_rows"]
+    combined = None
+    for i, ts in enumerate(tiles):
+        crc = int(ts.partition(":")[2], 16)
+        r1 = min((i + 1) * t, arr.shape[0])
+        nb = (r1 - i * t) * row_nbytes
+        combined = (
+            crc if combined is None else _native.crc_combine(combined, crc, nb)
+        )
+    assert f"crc32c:{combined:08x}" == e["checksum"]
