@@ -438,3 +438,35 @@ class TestDtypeCastOnRestore:
         path, w = self._take_bf16(tmp_path)
         out = Snapshot(path).read_object("0/m/w")
         assert out.dtype == ml_dtypes.bfloat16
+
+
+def test_edge_shapes_roundtrip(tmp_path):
+    """0-d scalars, empty arrays, and zero-size axes survive every path
+    (take/scrub/restore/read_object/incremental)."""
+    from tpusnap import verify_snapshot
+
+    cases = {
+        "scalar0d": np.float32(3.5) * np.ones((), np.float32),
+        "jscalar": jnp.asarray(2.5, jnp.float32),
+        "empty": np.zeros((0,), np.float32),
+        "zero_axis": np.zeros((4, 0, 8), np.float32),
+        "one": np.ones((1,), np.float32),
+    }
+    path = str(tmp_path / "s")
+    Snapshot.take(path, {"a": StateDict(**cases)})
+    assert verify_snapshot(path).clean
+    tgt = {
+        "a": StateDict(
+            **{k: np.zeros_like(np.asarray(v)) for k, v in cases.items()}
+        )
+    }
+    Snapshot(path).restore(tgt)
+    for k, v in cases.items():
+        got = np.asarray(tgt["a"][k])
+        assert got.shape == np.asarray(v).shape, k
+        assert got.tobytes() == np.asarray(v).tobytes(), k
+        out = Snapshot(path).read_object(f"0/a/{k}")
+        assert np.asarray(out).shape == np.asarray(v).shape, k
+    inc = str(tmp_path / "s2")
+    Snapshot.take(inc, {"a": StateDict(**cases)}, incremental_from=path)
+    assert verify_snapshot(inc).clean
