@@ -64,8 +64,11 @@ def main() -> None:
     progress = StateDict(epoch=0)
     app_state = {"train": train, "progress": progress}
     if args.resume_from:
-        Snapshot(args.resume_from).restore(app_state)
-        print(f"resumed at epoch {progress['epoch']}")
+        # Background restore: storage reads overlap the train-step
+        # compilation below; app_state must not be touched until wait().
+        pending_restore = Snapshot(args.resume_from).async_restore(app_state)
+    else:
+        pending_restore = None
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -73,6 +76,9 @@ def main() -> None:
         mesh, P("data", "fsdp") if use_ring else P(("data", "fsdp"), None)
     )
     rng = np.random.default_rng(0)
+    if pending_restore is not None:
+        pending_restore.wait()  # reads overlapped the setup above
+        print(f"resumed at epoch {progress['epoch']}")
     pending = None
     while progress["epoch"] < NUM_EPOCHS:
         state = train.tree

@@ -50,6 +50,20 @@ def test_simple_example_and_resume(tmp_path):
 
 def test_transformer_example(tmp_path):
     _run_example("transformer_example.py", "--work-dir", str(tmp_path))
+    # Resume from the last epoch snapshot: exercises async_restore
+    # (reads overlap setup) in the canonical flagship journey.
+    import glob
+
+    snaps = sorted(glob.glob(str(tmp_path / "epoch_*")))
+    assert snaps, "example produced no snapshots"
+    out = _run_example(
+        "transformer_example.py",
+        "--work-dir",
+        str(tmp_path),
+        "--resume-from",
+        snaps[-1],
+    )
+    assert "resumed at epoch" in out
 
 
 @pytest.mark.distributed

@@ -585,13 +585,29 @@ def _run_verifications(
         concurrency = get_scrub_concurrency()
 
     async def run() -> List[BlobCheck]:
+        import logging
+        import time
+
+        logger = logging.getLogger(__name__)
         work = enumerate(blobs)  # shared: each slot pulls the next, O(n)
         results: List[Tuple[int, BlobCheck]] = []
+        progress = {"bytes": 0, "last_log": time.monotonic()}
 
         async def slot() -> None:
             scratch: Dict[str, Any] = {}
             for i, blob in work:
-                results.append((i, await _verify_one(storage, blob, scratch)))
+                check = await _verify_one(storage, blob, scratch)
+                results.append((i, check))
+                progress["bytes"] += check.nbytes
+                now = time.monotonic()
+                if now - progress["last_log"] >= 10.0:
+                    progress["last_log"] = now
+                    logger.info(
+                        "scrub progress: %d/%d ranges, %.2f GB verified",
+                        len(results),
+                        len(blobs),
+                        progress["bytes"] / 1e9,
+                    )
 
         tasks = [
             asyncio.ensure_future(slot())
