@@ -503,3 +503,51 @@ def test_comm_collectives_world16():
     run_subprocess_world(
         _world_collectives, world_size=16, devices_per_process=1, timeout=480
     )
+
+
+def _world_overlapping_async_takes(snap_dir):
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict, verify_snapshot
+    from tpusnap.comm import get_communicator
+
+    comm = get_communicator()
+    rng = np.random.default_rng(comm.rank)
+
+    def state(step):
+        return StateDict(
+            local=rng.standard_normal((256, 32)).astype(np.float32) + step,
+            step=step,
+        )
+
+    # Three async takes launched back-to-back WITHOUT waiting between
+    # them: multiple PendingSnapshots in flight on one communicator
+    # (distinct KV barriers; epoch-bounded GC must not release a newer
+    # take's in-flight keys).
+    pendings = []
+    states = []
+    for step in range(3):
+        st = state(step)
+        states.append(st)
+        pendings.append(
+            Snapshot.async_take(f"{snap_dir}/s{step}", {"app": st})
+        )
+    snaps = [p.wait() for p in pendings]
+    for step, snap in enumerate(snaps):
+        assert snap.metadata.world_size == comm.world_size
+    if comm.rank == 0:
+        for step in range(3):
+            assert verify_snapshot(f"{snap_dir}/s{step}").clean, step
+    # Restore the newest on every rank; rank-local content round-trips.
+    target = {"app": StateDict(local=np.zeros((256, 32), np.float32), step=-1)}
+    Snapshot(f"{snap_dir}/s2").restore(target)
+    assert target["app"]["step"] == 2
+    np.testing.assert_array_equal(target["app"]["local"], states[2]["local"])
+
+
+def test_overlapping_async_takes():
+    """Back-to-back async_takes with all commits in flight at once."""
+    with tempfile.TemporaryDirectory() as d:
+        run_subprocess_world(
+            _world_overlapping_async_takes, world_size=2, args=[f"{d}/snap"]
+        )
