@@ -96,9 +96,18 @@ def cmd_ls(args) -> int:
         if is_container_entry(e) and not args.all:
             continue
         if args.long:
+            from .inspect import _entry_tensors
+
             n = entry_nbytes(e)
             crc = "✓" if entry_verifiable(e) else " "
-            print(f"{_fmt_bytes(n):>10s}  {crc}  {p}  [{_entry_desc(e)}]")
+            ext = (
+                "↗"
+                if any(
+                    t.location.startswith("../") for t in _entry_tensors(e)
+                )
+                else " "
+            )
+            print(f"{_fmt_bytes(n):>10s}  {crc}{ext}  {p}  [{_entry_desc(e)}]")
         else:
             print(p)
     return 0
