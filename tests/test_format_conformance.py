@@ -59,7 +59,9 @@ def _tensor_from_entry(root: str, e: dict) -> np.ndarray:
 
 def _external_reader(root: str):
     md = json.load(open(os.path.join(root, ".snapshot_metadata")))
-    assert set(md) == {"version", "world_size", "manifest"}
+    # Required keys per spec; other fields (created_at, future additions)
+    # are optional-and-ignorable.
+    assert {"version", "world_size", "manifest"} <= set(md)
 
     def read(path: str):
         e = md["manifest"][path]

@@ -1,0 +1,119 @@
+"""Retention (tpusnap/retention.py): keep newest N, materialize kept
+increments before deleting their bases, never destroy readable data."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpusnap import Snapshot, StateDict, verify_snapshot
+from tpusnap.knobs import override_batching_disabled
+from tpusnap.retention import apply_retention
+
+
+def _chain(tmp_path, n=3):
+    """s0 (full) <- s1 <- s2 incremental chain with a frozen blob and a
+    changing step; returns (root, states)."""
+    root = str(tmp_path)
+    frozen = np.random.default_rng(0).standard_normal((512, 64)).astype(np.float32)
+    prev = None
+    hots = []
+    with override_batching_disabled(True):
+        for i in range(n):
+            hot = np.full((64,), float(i), np.float32)
+            hots.append(hot)
+            path = os.path.join(root, f"s{i}")
+            Snapshot.take(
+                path,
+                {"app": StateDict(frozen=frozen, hot=hot, step=i)},
+                incremental_from=prev,
+            )
+            prev = path
+    return root, frozen, hots
+
+
+def test_keep_last_materializes_then_deletes(tmp_path):
+    root, frozen, hots = _chain(tmp_path)
+    plan = apply_retention(root, keep_last=1)
+    assert plan.executed
+    assert [os.path.basename(p) for p in plan.keep] == ["s2"]
+    assert sorted(os.path.basename(p) for p in plan.delete) == ["s0", "s1"]
+    assert [os.path.basename(p) for p in plan.materialize] == ["s2"]
+    assert plan.bytes_copied >= frozen.nbytes
+    assert sorted(os.listdir(root)) == ["s2"]
+    # The survivor is self-contained: restores and scrubs clean.
+    assert verify_snapshot(os.path.join(root, "s2")).clean
+    tgt = {"app": StateDict(frozen=np.zeros_like(frozen),
+                            hot=np.zeros(64, np.float32), step=-1)}
+    Snapshot(os.path.join(root, "s2")).restore(tgt)
+    assert tgt["app"]["step"] == 2
+    assert np.array_equal(tgt["app"]["frozen"], frozen)
+    assert np.array_equal(tgt["app"]["hot"], hots[2])
+
+
+def test_dry_run_touches_nothing(tmp_path):
+    root, _, _ = _chain(tmp_path)
+    plan = apply_retention(root, keep_last=1, dry_run=True)
+    assert not plan.executed
+    assert sorted(os.path.basename(p) for p in plan.delete) == ["s0", "s1"]
+    assert sorted(os.listdir(root)) == ["s0", "s1", "s2"]
+    # Chain still intact and readable.
+    assert verify_snapshot(os.path.join(root, "s2")).clean
+
+
+def test_keep_two_materializes_both_dependents(tmp_path):
+    """Chains collapse to the oldest base, so BOTH kept increments
+    reference doomed s0 and both must be materialized."""
+    root, frozen, hots = _chain(tmp_path)
+    plan = apply_retention(root, keep_last=2)
+    assert sorted(os.path.basename(p) for p in plan.materialize) == ["s1", "s2"]
+    assert sorted(os.listdir(root)) == ["s1", "s2"]
+    for name, hot in (("s1", hots[1]), ("s2", hots[2])):
+        assert verify_snapshot(os.path.join(root, name)).clean
+        tgt = {"app": StateDict(frozen=np.zeros_like(frozen),
+                                hot=np.zeros(64, np.float32), step=-1)}
+        Snapshot(os.path.join(root, name)).restore(tgt)
+        assert np.array_equal(tgt["app"]["hot"], hot), name
+
+
+def test_keep_all_is_noop(tmp_path):
+    root, _, _ = _chain(tmp_path)
+    plan = apply_retention(root, keep_last=10)
+    assert plan.executed and not plan.delete and not plan.materialize
+    assert sorted(os.listdir(root)) == ["s0", "s1", "s2"]
+
+
+def test_refuses_object_store_roots():
+    with pytest.raises(ValueError, match="local filesystem"):
+        apply_retention("gs://bkt/snaps", keep_last=1, dry_run=True)
+
+
+def test_ordering_survives_mtime_resets(tmp_path):
+    """Ordering comes from metadata created_at, not file mtimes: a
+    materialize (atomic metadata rewrite) or an rsync that resets mtimes
+    must not flip which snapshots retention considers newest."""
+    root, frozen, hots = _chain(tmp_path)
+    # Adversarial mtimes: make s0 look newest and s2 oldest on disk.
+    now = time.time()
+    for i, bump in (("0", 100), ("1", 50), ("2", 0)):
+        meta = os.path.join(root, f"s{i}", ".snapshot_metadata")
+        os.utime(meta, (now + bump, now + bump))
+    plan = apply_retention(root, keep_last=1)
+    assert [os.path.basename(p) for p in plan.keep] == ["s2"]
+    assert sorted(os.listdir(root)) == ["s2"]
+    assert verify_snapshot(os.path.join(root, "s2")).clean
+
+
+def test_cli_retain(tmp_path, capsys):
+    from tpusnap.__main__ import main as cli_main
+
+    root, _, _ = _chain(tmp_path)
+    assert cli_main(["retain", root, "--keep", "1", "--dry-run"]) == 0
+    out = capsys.readouterr().out
+    assert "would delete" in out and "s0" in out
+    assert sorted(os.listdir(root)) == ["s0", "s1", "s2"]
+    assert cli_main(["retain", root, "--keep", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "deleted" in out
+    assert sorted(os.listdir(root)) == ["s2"]

@@ -12,6 +12,9 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
   diff        A B       compare two snapshots by recorded checksums only
                         (no data reads; exit 2 = provably different,
                         3 = undecidable without reading data)
+  retain ROOT --keep N  keep the newest N snapshots under ROOT; any kept
+                        increment referencing a doomed base is
+                        materialized first, then the rest are deleted
 
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found.
 """
@@ -90,14 +93,14 @@ def cmd_info(args) -> int:
 
 
 def cmd_ls(args) -> int:
+    from .inspect import _entry_tensors
+
     md = Snapshot(args.path).metadata
     for p in sorted(md.manifest):
         e = md.manifest[p]
         if is_container_entry(e) and not args.all:
             continue
         if args.long:
-            from .inspect import _entry_tensors
-
             n = entry_nbytes(e)
             crc = "✓" if entry_verifiable(e) else " "
             ext = (
@@ -166,6 +169,19 @@ def cmd_diff(args) -> int:
     return 0 if d.same else 3
 
 
+def cmd_retain(args) -> int:
+    from .retention import apply_retention
+
+    plan = apply_retention(args.root, args.keep, dry_run=args.dry_run)
+    would = "" if plan.executed else "would "
+    for s in plan.materialize:
+        print(f"{would}materialize {s}")
+    for s in plan.delete:
+        print(f"{would}delete {s}")
+    print(plan.summary())
+    return 0
+
+
 def cmd_cat(args) -> int:
     out = Snapshot(args.path).read_object(args.manifest_path)
     if isinstance(out, np.ndarray):
@@ -220,6 +236,16 @@ def main(argv=None) -> int:
         "-q", "--quiet", action="store_true", help="summary line only"
     )
     p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser(
+        "retain",
+        help="keep the newest N snapshots under a directory; materialize "
+        "kept increments, then delete the rest (local fs only)",
+    )
+    p.add_argument("root")
+    p.add_argument("--keep", type=int, required=True, metavar="N")
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(fn=cmd_retain)
 
     try:
         args = parser.parse_args(argv)

@@ -418,13 +418,24 @@ class SnapshotMetadata:
     version: str
     world_size: int
     manifest: Manifest
+    # Commit wall-clock (rank 0's time.time() at take) — recorded IN the
+    # metadata because file mtimes are unreliable ordering signals
+    # (materialize's atomic rewrite, rsync/copies reset them; retention
+    # ordering by mtime could delete the newest checkpoints). Optional:
+    # absent in pre-field snapshots.
+    created_at: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d: Dict[str, Any] = {
             "version": self.version,
             "world_size": self.world_size,
-            "manifest": {k: _entry_to_dict(v) for k, v in self.manifest.items()},
         }
+        if self.created_at is not None:
+            d["created_at"] = self.created_at
+        d["manifest"] = {
+            k: _entry_to_dict(v) for k, v in self.manifest.items()
+        }
+        return d
 
     def to_yaml(self) -> str:
         # JSON is a subset of YAML; json.dumps is much faster than yaml.dump
@@ -434,7 +445,12 @@ class SnapshotMetadata:
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "SnapshotMetadata":
         manifest = {k: entry_from_dict(v) for k, v in d["manifest"].items()}
-        return cls(version=d["version"], world_size=d["world_size"], manifest=manifest)
+        return cls(
+            version=d["version"],
+            world_size=d["world_size"],
+            manifest=manifest,
+            created_at=d.get("created_at"),
+        )
 
     @classmethod
     def from_yaml(cls, s: str) -> "SnapshotMetadata":

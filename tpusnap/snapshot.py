@@ -689,8 +689,13 @@ def _take_impl(
     # gathers before scheduling (snapshot.py:842-853) only because its
     # entries are final at prepare time.
     global_manifest = _gather_manifest(entries, comm)
+    import time
+
     metadata = SnapshotMetadata(
-        version=__version__, world_size=comm.world_size, manifest=global_manifest
+        version=__version__,
+        world_size=comm.world_size,
+        manifest=global_manifest,
+        created_at=time.time(),
     )
     return pending_io_work, metadata, path, storage
 
