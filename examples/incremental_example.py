@@ -15,7 +15,6 @@ Run: python examples/incremental_example.py [--work-dir DIR]
 
 import argparse
 import os
-import shutil
 import sys
 import tempfile
 
@@ -79,15 +78,15 @@ def main() -> None:
     print(f"verify {latest}: {report.summary()}")
     assert report.clean
 
-    # Retention: make the latest self-contained, then delete the others.
-    stats = Snapshot(latest).materialize()
-    print(
-        f"materialize: copied {stats['blobs_copied']} blob(s), "
-        f"{stats['bytes_copied'] / 1e6:.1f} MB"
-    )
-    assert stats["blobs_copied"] >= 1  # the frozen tower lived in step_0
-    for epoch in range(NUM_EPOCHS - 1):
-        shutil.rmtree(snap_path(epoch))
+    # Retention: keep only the newest snapshot. apply_retention
+    # materializes it (copies the base-referenced blobs in, verified)
+    # BEFORE deleting the older snapshots it depended on.
+    from tpusnap.retention import apply_retention
+
+    plan = apply_retention(work_dir, keep_last=1)
+    print(f"retention: {plan.summary()}")
+    assert plan.bytes_copied >= frozen_tower.nbytes
+    assert os.listdir(work_dir) == [os.path.basename(latest)]
 
     # The survivor still restores bit-exactly.
     target = {

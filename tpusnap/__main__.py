@@ -75,6 +75,13 @@ def cmd_info(args) -> int:
     external = [b for b in iter_blobs(md.manifest) if b.location.startswith("../")]
     print(f"path:        {args.path}")
     print(f"version:     {md.version}")
+    if md.created_at is not None:
+        import datetime
+
+        ts = datetime.datetime.fromtimestamp(
+            md.created_at, tz=datetime.timezone.utc
+        )
+        print(f"created:     {ts.isoformat(timespec='seconds')}")
     print(f"world_size:  {md.world_size}")
     print(f"payload:     {_fmt_bytes(total)}")
     print(f"entries:     {sum(counts.values())}")
