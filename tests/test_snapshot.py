@@ -470,3 +470,30 @@ def test_edge_shapes_roundtrip(tmp_path):
     inc = str(tmp_path / "s2")
     Snapshot.take(inc, {"a": StateDict(**cases)}, incremental_from=path)
     assert verify_snapshot(inc).clean
+
+
+def test_load_snapshot_without_program(tmp_path):
+    """load_snapshot: the whole app state back as plain host structures,
+    no statefuls or targets required (debugging/migration path)."""
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("x", "y"))
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x", "y"))
+    w = jax.device_put(jnp.arange(32 * 32, dtype=jnp.float32).reshape(32, 32), sh)
+    st = StateDict(
+        dense=np.random.default_rng(0).standard_normal((64, 8)).astype(np.float32),
+        step=5,
+        nested={"lr": 0.5, "l": [1, 2]},
+    )
+    path = str(tmp_path / "s")
+    Snapshot.take(path, {"m": PytreeState({"w": w}), "t": st})
+
+    from tpusnap import load_snapshot
+
+    out = load_snapshot(path)
+    assert set(out) == {"m", "t"}
+    assert np.array_equal(out["m"]["w"], np.asarray(w))  # sharded -> dense
+    assert np.array_equal(out["t"]["dense"], st["dense"])
+    assert out["t"]["step"] == 5
+    assert out["t"]["nested"] == {"lr": 0.5, "l": [1, 2]}
+    # Budgeted load works too.
+    out2 = load_snapshot(path, memory_budget_bytes=16 << 20)
+    assert np.array_equal(out2["t"]["dense"], st["dense"])

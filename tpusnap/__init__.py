@@ -16,7 +16,12 @@ try:  # pragma: no cover - import surface grows as modules land
     from .state_dict import StateDict  # noqa: F401
     from .rng_state import RNGState  # noqa: F401
     from .pytree_state import PytreeState  # noqa: F401
-    from .snapshot import PendingRestore, PendingSnapshot, Snapshot  # noqa: F401
+    from .snapshot import (  # noqa: F401
+        PendingRestore,
+        PendingSnapshot,
+        Snapshot,
+        load_snapshot,
+    )
     from .host_offload import (  # noqa: F401
         is_host_resident,
         supports_host_offload,
@@ -32,6 +37,7 @@ try:  # pragma: no cover - import surface grows as modules land
         "Snapshot",
         "PendingSnapshot",
         "PendingRestore",
+        "load_snapshot",
         "Stateful",
         "AppState",
         "StateDict",

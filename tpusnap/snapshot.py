@@ -862,6 +862,85 @@ def _write_metadata(
     )
 
 
+def load_snapshot(
+    path: str,
+    rank: int = 0,
+    storage_options: Optional[Dict[str, Any]] = None,
+    memory_budget_bytes: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Load a whole snapshot into host memory WITHOUT the original
+    program: no statefuls, no target arrays — the nested structure is
+    rebuilt from the manifest (dicts/lists/tuples, host numpy leaves,
+    primitives). ``rank`` selects the manifest view (replicated entries
+    are visible to every rank; sharded entries come back as full dense
+    arrays). The debugging/migration companion to ``restore``: inspect a
+    checkpoint from a plain REPL, or feed it to another framework.
+
+    Peak memory is the whole selected state plus transient read buffers
+    (budget-gated); use ``Snapshot.read_object`` for one value.
+    """
+    out: Dict[str, Any] = {}
+    # Out-of-band single-process tool: the no-op Communicator, NOT
+    # get_communicator() — auto-detection inside a live jax.distributed
+    # job would turn the budget's hostname gather into a collective that
+    # only this rank executes (deadlock).
+    budget = memory_budget_bytes or get_process_memory_budget_bytes(
+        Communicator()
+    )
+    with Snapshot(path, storage_options) as snap:
+        with snap._op_lock:
+            event_loop, storage = snap._resources()
+            metadata = snap._get_metadata(storage, event_loop)
+            local_manifest = get_manifest_for_rank(metadata, rank)
+            top_keys = sorted({p.split("/", 1)[0] for p in local_manifest})
+            for key in top_keys:
+                key_manifest = {
+                    p: e
+                    for p, e in local_manifest.items()
+                    if p == key or p.startswith(key + "/")
+                }
+                out[key] = _read_and_inflate(
+                    key, key_manifest, {}, storage, budget, rank, event_loop
+                )
+    return out
+
+
+def _read_and_inflate(
+    key: str,
+    key_manifest: Manifest,
+    target_flattened: Dict[str, Any],
+    storage: StoragePlugin,
+    memory_budget: int,
+    rank: int,
+    event_loop: asyncio.AbstractEventLoop,
+) -> Any:
+    """The one read pipeline for a key's manifest subtree: prepare reads
+    (against targets when given), batch, execute under the budget,
+    inflate. Shared by ``restore`` (targets from the current state_dict)
+    and ``load_snapshot`` (no targets)."""
+    from .batcher import batch_read_requests
+
+    read_reqs = []
+    futures: Dict[str, Any] = {}
+    for logical_path, entry in key_manifest.items():
+        if is_container_entry(entry):
+            continue
+        reqs, fut = prepare_read(
+            entry,
+            obj_out=target_flattened.get(logical_path),
+            logical_path=logical_path,
+        )
+        read_reqs.extend(reqs)
+        futures[logical_path] = fut
+    read_reqs = batch_read_requests(read_reqs)
+    sync_execute_read_reqs(read_reqs, storage, memory_budget, rank, event_loop)
+    flattened = {p: fut.obj for p, fut in futures.items()}
+    container_manifest = {
+        p: e for p, e in key_manifest.items() if is_container_entry(e)
+    }
+    return inflate(container_manifest, flattened, prefix=key)
+
+
 def _load_stateful(
     stateful: Stateful,
     key: str,
@@ -886,29 +965,15 @@ def _load_stateful(
     target_manifest, target_flattened = flatten(stateful.state_dict(), prefix=key)
     handle_sharded_elasticity(local_manifest, target_flattened)
 
-    read_reqs = []
-    futures: Dict[str, Any] = {}
-    for logical_path, entry in local_manifest.items():
-        if is_container_entry(entry):
-            continue
-        reqs, fut = prepare_read(
-            entry,
-            obj_out=target_flattened.get(logical_path),
-            logical_path=logical_path,
-        )
-        read_reqs.extend(reqs)
-        futures[logical_path] = fut
-
-    from .batcher import batch_read_requests
-
-    read_reqs = batch_read_requests(read_reqs)
-    sync_execute_read_reqs(read_reqs, storage, memory_budget, rank, event_loop)
-
-    flattened = {p: fut.obj for p, fut in futures.items()}
-    container_manifest = {
-        p: e for p, e in local_manifest.items() if is_container_entry(e)
-    }
-    restored = inflate(container_manifest, flattened, prefix=key)
+    restored = _read_and_inflate(
+        key,
+        local_manifest,
+        target_flattened,
+        storage,
+        memory_budget,
+        rank,
+        event_loop,
+    )
     stateful.load_state_dict(restored)
 
 
