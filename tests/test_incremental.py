@@ -463,3 +463,62 @@ def test_materialize_refuses_corrupt_base(tmp_path):
     # Manifest untouched: still references the base.
     md = Snapshot(inc).metadata
     assert md.manifest["0/app/w"].location.startswith("../")
+
+
+def _world_elastic_incremental(base_dir, inc_dir, phase):
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict, verify_snapshot
+    from tpusnap.comm import get_communicator
+
+    comm = get_communicator()
+    shared = np.arange(4096, dtype=np.float32)
+    if phase == "save":  # world 2: the base
+        state = StateDict(
+            shared=shared, own=np.full((64,), float(comm.rank), np.float32)
+        )
+        Snapshot.take(base_dir, {"m": state}, replicated=["m/shared"])
+    else:  # world 3: incremental take on the world-2 base
+        state = StateDict(
+            shared=shared,  # unchanged -> dedups against the base
+            own=np.full((64,), 10.0 + comm.rank, np.float32),  # changed
+        )
+        Snapshot.take(
+            inc_dir, {"m": state}, replicated=["m/shared"],
+            incremental_from=base_dir,
+        )
+        md = Snapshot(inc_dir).metadata
+        assert md.world_size == 3
+        # The replicated blob deduped against the base across the
+        # world-size change; per-rank blobs (changed) were written.
+        assert md.manifest["0/m/shared"].location.startswith("../")
+        for r in range(3):
+            assert not md.manifest[f"{r}/m/own"].location.startswith("../")
+        if comm.rank == 0:
+            assert verify_snapshot(inc_dir).clean
+        dst = {"m": StateDict(
+            shared=np.zeros(4096, np.float32), own=np.zeros(64, np.float32)
+        )}
+        Snapshot(inc_dir).restore(dst)
+        np.testing.assert_array_equal(dst["m"]["shared"], shared)
+        np.testing.assert_array_equal(
+            dst["m"]["own"], np.full((64,), 10.0 + comm.rank, np.float32)
+        )
+
+
+def test_elastic_incremental_upscale(tmp_path):
+    """Incremental take at world 3 against a world-2 base: the new rank's
+    manifest view (replicated re-expansion) feeds dedup; unchanged
+    replicated state references the base, changed per-rank state writes."""
+    from tpusnap.test_utils import run_subprocess_world
+
+    base, inc = str(tmp_path / "base"), str(tmp_path / "inc")
+    with override_batching_disabled(True):
+        run_subprocess_world(
+            _world_elastic_incremental, world_size=2, args=[base, inc, "save"],
+            extra_env={"TPUSNAP_DISABLE_BATCHING": "1"},
+        )
+        run_subprocess_world(
+            _world_elastic_incremental, world_size=3, args=[base, inc, "load"],
+            extra_env={"TPUSNAP_DISABLE_BATCHING": "1"},
+        )
