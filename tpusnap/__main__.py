@@ -1,4 +1,4 @@
-"""Snapshot inspection CLI: ``python -m tpusnap {info,ls,verify,cat} ...``
+"""Snapshot operations CLI: ``python -m tpusnap <command> ...``
 
 Operational tooling over the manifest + checksum machinery (no reference
 counterpart — torchsnapshot ships no CLI and no integrity checking):
