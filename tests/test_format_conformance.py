@@ -182,3 +182,27 @@ def test_tile_checksums_fold_per_spec(tmp_path):
             crc if combined is None else _native.crc_combine(combined, crc, nb)
         )
     assert f"crc32c:{combined:08x}" == e["checksum"]
+
+
+def test_unknown_fields_are_ignorable(tmp_path):
+    """Forward compatibility per the spec: a snapshot written by a future
+    tpusnap with extra entry/metadata fields must load with this one."""
+    path = str(tmp_path / "snap")
+    with override_batching_disabled(True):
+        Snapshot.take(
+            path, {"a": StateDict(w=np.arange(64, dtype=np.float32), n=3)}
+        )
+    meta_path = os.path.join(path, ".snapshot_metadata")
+    md = json.load(open(meta_path))
+    md["future_top_level"] = {"x": 1}
+    for e in md["manifest"].values():
+        e["future_field"] = "ignored"
+    json.dump(md, open(meta_path, "w"))
+
+    target = {"a": StateDict(w=np.zeros(64, np.float32), n=0)}
+    Snapshot(path).restore(target)
+    assert np.array_equal(target["a"]["w"], np.arange(64, dtype=np.float32))
+    assert target["a"]["n"] == 3
+    from tpusnap import verify_snapshot
+
+    assert verify_snapshot(path).clean
