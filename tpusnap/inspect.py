@@ -244,12 +244,13 @@ def materialize_snapshot(
     snapshot, rewrite the manifest, and re-commit ``.snapshot_metadata``.
     Afterwards the base snapshot(s) may be deleted.
 
-    Blobs are copied whole (slab references keep their byte ranges), one
-    at a time. Before the manifest is committed, every copied range is
-    verified against its recorded checksum — bit-rot in a base is caught
-    HERE, while the base still exists, not after the user deleted it;
-    the verification keeps 4 reads in flight, so peak memory is up to 4
-    scratch buffers of the largest copied blob (bounded by the
+    Blobs are copied whole (slab references keep their byte ranges),
+    two in flight so one blob's read overlaps another's write. Before
+    the manifest is committed, every copied range is verified against
+    its recorded checksum — bit-rot in a base is caught HERE, while the
+    base still exists, not after the user deleted it. Peak memory: the
+    copy phase holds up to 2 whole blobs, the verification phase up to
+    4 scratch buffers of the largest copied blob (all bounded by the
     max-chunk/max-shard knobs, 512 MB class each). The metadata rewrite itself is
     atomic (temp + rename on fs; single PUT on object stores), so a
     failure at any point leaves the snapshot valid and base-referencing.
@@ -302,12 +303,22 @@ def materialize_snapshot(
                         "materialize"
                     )
 
-            for ext, local in sorted(local_for.items()):
+            # Two copies in flight: one blob's read overlaps another's
+            # write. Not more — each in-flight copy holds a whole blob
+            # (512 MB class) in memory.
+            async def _copy_one(pair, _ctx) -> int:
+                ext, local = pair
                 blob_io = ReadIO(path=ext)  # whole object
-                storage.sync_read(blob_io, event_loop)
+                await storage.read(blob_io)
                 data = blob_io.buf.getbuffer()
-                storage.sync_write(WriteIO(path=local, buf=data), event_loop)
-                bytes_copied += data.nbytes
+                await storage.write(WriteIO(path=local, buf=data))
+                return data.nbytes
+
+            bytes_copied = sum(
+                _bounded_run(
+                    event_loop, sorted(local_for.items()), _copy_one, 2
+                )
+            )
 
             for entry in metadata.manifest.values():
                 for t in _entry_tensors(entry):
@@ -569,6 +580,48 @@ async def _verify_one(
     return mk("ok")
 
 
+def _bounded_run(
+    event_loop: asyncio.AbstractEventLoop,
+    items,
+    worker,
+    concurrency: int,
+    slot_ctx=dict,
+):
+    """Run ``await worker(item, ctx)`` over ``items`` with ``concurrency``
+    slots; each slot owns one reusable ``slot_ctx()`` (e.g. a scratch
+    buffer holder). Results come back in input order. On any failure the
+    sibling slot tasks are cancelled AND drained — gather alone would
+    strand them on the caller's (possibly cached-Snapshot, reused) loop,
+    where the next run_until_complete resumes them mid-close. The one
+    bounded-concurrency engine for the scrub and materialize copies."""
+
+    async def run():
+        work = enumerate(items)  # shared: each slot pulls the next, O(n)
+        results = []
+
+        async def slot() -> None:
+            ctx = slot_ctx()
+            for i, item in work:
+                results.append((i, await worker(item, ctx)))
+
+        tasks = [
+            asyncio.ensure_future(slot())
+            for _ in range(max(1, concurrency))
+        ]
+        try:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        return [r for _, r in sorted(results, key=lambda ir: ir[0])]
+
+    from .io_types import run_on_loop
+
+    return run_on_loop(event_loop, run())
+
+
 def _run_verifications(
     storage: StoragePlugin,
     event_loop: asyncio.AbstractEventLoop,
@@ -579,57 +632,34 @@ def _run_verifications(
     is latency-bound on serial tile reads otherwise. Each slot owns one
     reusable scratch buffer, so peak memory is concurrency x the largest
     range a slot sees (TPUSNAP_SCRUB_CONCURRENCY, default 4)."""
+    import logging
+    import time
+
     if concurrency is None:
         from .knobs import get_scrub_concurrency
 
         concurrency = get_scrub_concurrency()
+    logger = logging.getLogger(__name__)
+    progress = {"count": 0, "bytes": 0, "last_log": time.monotonic()}
 
-    async def run() -> List[BlobCheck]:
-        import logging
-        import time
+    async def verify_one(blob, scratch) -> BlobCheck:
+        check = await _verify_one(storage, blob, scratch)
+        progress["count"] += 1
+        progress["bytes"] += check.nbytes
+        now = time.monotonic()
+        if now - progress["last_log"] >= 10.0:
+            progress["last_log"] = now
+            logger.info(
+                "scrub progress: %d/%d ranges, %.2f GB verified",
+                progress["count"],
+                len(blobs),
+                progress["bytes"] / 1e9,
+            )
+        return check
 
-        logger = logging.getLogger(__name__)
-        work = enumerate(blobs)  # shared: each slot pulls the next, O(n)
-        results: List[Tuple[int, BlobCheck]] = []
-        progress = {"bytes": 0, "last_log": time.monotonic()}
-
-        async def slot() -> None:
-            scratch: Dict[str, Any] = {}
-            for i, blob in work:
-                check = await _verify_one(storage, blob, scratch)
-                results.append((i, check))
-                progress["bytes"] += check.nbytes
-                now = time.monotonic()
-                if now - progress["last_log"] >= 10.0:
-                    progress["last_log"] = now
-                    logger.info(
-                        "scrub progress: %d/%d ranges, %.2f GB verified",
-                        len(results),
-                        len(blobs),
-                        progress["bytes"] / 1e9,
-                    )
-
-        tasks = [
-            asyncio.ensure_future(slot())
-            for _ in range(max(1, concurrency))
-        ]
-        try:
-            await asyncio.gather(*tasks)
-        except BaseException:
-            # gather propagates the first failure WITHOUT cancelling the
-            # siblings; stranded tasks on a reused (cached-Snapshot) loop
-            # would resume mid-close or during a later call.
-            for t in tasks:
-                t.cancel()
-            await asyncio.gather(*tasks, return_exceptions=True)
-            raise
-        # Manifest order, not completion order: scrub output must be
-        # deterministic across runs (operators diff it).
-        return [c for _, c in sorted(results, key=lambda ic: ic[0])]
-
-    from .io_types import run_on_loop
-
-    return run_on_loop(event_loop, run())
+    # Results return in manifest order, not completion order: scrub
+    # output must be deterministic across runs (operators diff it).
+    return _bounded_run(event_loop, blobs, verify_one, concurrency)
 
 
 def verify_snapshot(
