@@ -522,3 +522,37 @@ def test_elastic_incremental_upscale(tmp_path):
             _world_elastic_incremental, world_size=3, args=[base, inc, "load"],
             extra_env={"TPUSNAP_DISABLE_BATCHING": "1"},
         )
+
+
+def test_materialized_snapshot_reshards_on_restore(tmp_path):
+    """materialize rewrites shard locations; the overlap-read reshard
+    path must work off the local copies (base deleted) into a different
+    target sharding."""
+    import shutil
+
+    devs = np.array(jax.devices())
+    mesh_a = jax.sharding.Mesh(devs.reshape(2, 4), ("x", "y"))
+    mesh_b = jax.sharding.Mesh(devs.reshape(4, 2), ("x", "y"))
+    spec = jax.sharding.PartitionSpec("x", "y")
+    w = jax.device_put(
+        jnp.arange(64 * 64, dtype=jnp.float32).reshape(64, 64),
+        jax.sharding.NamedSharding(mesh_a, spec),
+    )
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    Snapshot.take(base, {"m": PytreeState({"w": w})})
+    Snapshot.take(inc, {"m": PytreeState({"w": w})}, incremental_from=base)
+    Snapshot(inc).materialize()
+    shutil.rmtree(base)
+    target = PytreeState(
+        {
+            "w": jax.device_put(
+                jnp.zeros((64, 64), jnp.float32),
+                jax.sharding.NamedSharding(mesh_b, spec),
+            )
+        }
+    )
+    Snapshot(inc).restore({"m": target})
+    restored = target.tree["w"]
+    assert restored.sharding.mesh.shape == {"x": 4, "y": 2}
+    assert np.array_equal(np.asarray(restored), np.asarray(w))
+    assert verify_snapshot(inc).clean
