@@ -556,3 +556,35 @@ def test_materialized_snapshot_reshards_on_restore(tmp_path):
     assert restored.sharding.mesh.shape == {"x": 4, "y": 2}
     assert np.array_equal(np.asarray(restored), np.asarray(w))
     assert verify_snapshot(inc).clean
+
+
+def test_async_incremental_mutation_isolation(tmp_path):
+    """Async incremental take with a CHANGED leaf: the dedup miss takes
+    the hash-then-clone branch, and the clone must freeze the content
+    before training mutates it (deduped leaves never clone — no write)."""
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    frozen = np.random.default_rng(0).standard_normal((256, 64)).astype(np.float32)
+    hot = np.arange(512, dtype=np.float32)
+    frozen_orig = frozen.copy()
+    with override_batching_disabled(True):
+        Snapshot.take(base, {"app": StateDict(frozen=frozen, hot=hot)})
+        hot2 = hot + 1.0
+        state = StateDict(frozen=frozen, hot=hot2)
+        pending = Snapshot.async_take(
+            inc, {"app": state}, incremental_from=base
+        )
+        # Training continues: overwrite both arrays AFTER control returned.
+        hot2[:] = -99.0
+        frozen_view = state["frozen"]
+        frozen_view[:] = -77.0
+        snap = pending.wait()
+    assert _blob_files(inc) == ["0/app/hot"]  # only the changed leaf wrote
+    assert snap.verify().clean
+    target = {"app": StateDict(frozen=np.zeros_like(frozen), hot=np.zeros(512, np.float32))}
+    Snapshot(inc).restore(target)
+    # hot: pre-mutation changed value (clone froze it).
+    assert np.array_equal(target["app"]["hot"], hot + 1.0)
+    # frozen: deduped against the base — the BASE's bytes, untouched by
+    # the post-return mutation of the live array (which aliases `frozen`,
+    # hence the pre-mutation copy).
+    assert np.array_equal(target["app"]["frozen"], frozen_orig)
