@@ -168,6 +168,7 @@ class _Reporter:
             "total_s": elapsed,
             "staging_s": staging_elapsed,
             "throughput_mbps": self.bytes_done / 1e6 / elapsed,
+            "budget_bytes": self.total_budget,
         }
         LAST_EXECUTION_STATS[self.verb] = stats
         if staging_elapsed is not None:
