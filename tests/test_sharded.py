@@ -124,3 +124,104 @@ def test_sharded_bf16_bit_exact(tmp_path):
                                            NamedSharding(mesh, P("x", "y"))))}
     snap.restore(dst)
     assert np.asarray(dst["s"]["a"]).tobytes() == np.asarray(src).tobytes()
+
+
+class TestShardedSaveTimeTransform:
+    """The save-time transform threads through the SHARDED preparer
+    (reference io_preparer.py:100-106, sharded_tensor.py:133,159): on
+    TPU essentially all interesting training state is
+    NamedSharding-sharded, so ``cast_on_save`` must reach it."""
+
+    def _take_bf16(self, tmp_path, spec=P("x")):
+        import ml_dtypes
+
+        from tpusnap.transforms import cast_on_save
+
+        mesh = _mesh()
+        w = (
+            np.linspace(-2, 2, np.prod(SHAPE))
+            .astype(np.float32)
+            .reshape(SHAPE)
+        )
+        src = jax.device_put(jnp.asarray(w), NamedSharding(mesh, spec))
+        path = str(tmp_path / "snap")
+        Snapshot.take(
+            path,
+            {"s": StateDict(w=src)},
+            _custom_array_prepare_func=cast_on_save({"**": jnp.bfloat16}),
+        )
+        expect = w.astype(ml_dtypes.bfloat16)
+        return path, mesh, w, expect
+
+    def test_manifest_records_stored_dtype(self, tmp_path):
+        path, _, _, _ = self._take_bf16(tmp_path)
+        entry = Snapshot(path).get_manifest()["0/s/w"]
+        assert isinstance(entry, ShardedEntry)
+        assert entry.dtype == "bfloat16"
+        assert all(s.tensor.dtype == "bfloat16" for s in entry.shards)
+        # Stored blob bytes are half-width: (4,12) bf16 shard = 96 bytes.
+        from tpusnap.serialization import tensor_nbytes
+
+        assert all(
+            tensor_nbytes(s.tensor.dtype, s.tensor.shape)
+            == np.prod(s.sizes) * 2
+            for s in entry.shards
+        )
+
+    def test_restore_upcasts_into_f32_sharded_target(self, tmp_path):
+        path, mesh, _, expect = self._take_bf16(tmp_path)
+        # Full-precision training target with a DIFFERENT sharding:
+        # reshard + upcast in one restore.
+        dst = {
+            "s": StateDict(
+                w=jax.device_put(
+                    jnp.zeros(SHAPE, jnp.float32),
+                    NamedSharding(mesh, P(None, "y")),
+                )
+            )
+        }
+        Snapshot(path).restore(dst)
+        out = dst["s"]["w"]
+        assert out.dtype == jnp.float32
+        assert out.sharding.is_equivalent_to(
+            NamedSharding(mesh, P(None, "y")), out.ndim
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), expect.astype(np.float32)
+        )
+
+    def test_restore_bit_exact_into_bf16_target(self, tmp_path):
+        path, mesh, _, expect = self._take_bf16(tmp_path)
+        dst = {
+            "s": StateDict(
+                w=jax.device_put(
+                    jnp.zeros(SHAPE, jnp.bfloat16),
+                    NamedSharding(mesh, P("x")),
+                )
+            )
+        }
+        Snapshot(path).restore(dst)
+        assert np.asarray(dst["s"]["w"]).tobytes() == expect.tobytes()
+
+    def test_read_object_dense_returns_stored_dtype(self, tmp_path):
+        path, _, _, expect = self._take_bf16(tmp_path)
+        out = Snapshot(path).read_object("0/s/w")
+        assert str(out.dtype) == "bfloat16"
+        assert np.asarray(out).tobytes() == expect.tobytes()
+
+    def test_np_dense_target_upcasts_in_place(self, tmp_path):
+        path, _, _, expect = self._take_bf16(tmp_path)
+        target = np.zeros(SHAPE, np.float32)
+        out = Snapshot(path).read_object("0/s/w", obj_out=target)
+        assert out is target
+        np.testing.assert_array_equal(target, expect.astype(np.float32))
+
+    def test_subdivision_uses_stored_itemsize(self, tmp_path):
+        """max_shard_size applies to the blob as WRITTEN: a 192-byte f32
+        shard casting to 96 bytes of bf16 fits a 96-byte cap unsplit."""
+        with override_max_shard_size_bytes(96):
+            path, _, _, _ = self._take_bf16(tmp_path)
+        entry = Snapshot(path).get_manifest()["0/s/w"]
+        # P("x") on the 4x2 mesh -> 4 distinct (4,12) pieces; each is
+        # 96 B stored, exactly at the cap -> no subdivision.
+        assert len(entry.shards) == 4

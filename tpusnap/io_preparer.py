@@ -58,8 +58,11 @@ def prepare_write(
 ) -> Tuple[Entry, List[WriteReq]]:
     """``array_prepare_func(arr, tracing) -> arr`` is the user save-time
     transform (reference _custom_tensor_prepare_func, snapshot.py:
-    170-196); it applies to dense and chunked arrays — sharded arrays
-    and non-array objects pass through untransformed.
+    170-196); it applies to dense, chunked AND sharded arrays — the
+    sharded preparer applies it per local shard, like the reference
+    threads its tensor_prepare_func into the sharded path
+    (reference io_preparer.py:100-106, sharded_tensor.py:133,159).
+    Non-array objects pass through untransformed.
     ``array_prepare_traced`` is the already-traced (dtype, shape) from
     the write-load estimator, so untraceable transforms don't execute a
     second discarded time here.
@@ -79,6 +82,8 @@ def prepare_write(
             storage_path,
             obj,
             is_async_snapshot=is_async_snapshot,
+            array_prepare_func=array_prepare_func,
+            array_prepare_traced=array_prepare_traced,
             prev_entry=prev_entry,
         )
 

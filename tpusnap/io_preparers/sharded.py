@@ -52,7 +52,7 @@ from ..serialization import (
     string_to_dtype,
     tensor_nbytes,
 )
-from .array import ArrayBufferStager
+from .array import ArrayBufferStager, trace_array_prepare
 
 
 def is_sharded(arr: Any) -> bool:
@@ -116,9 +116,23 @@ class ShardedArrayIOPreparer:
         storage_path: str,
         arr: jax.Array,
         is_async_snapshot: bool = False,
+        array_prepare_func=None,
+        array_prepare_traced: Optional[Tuple[str, List[int]]] = None,
         prev_entry=None,
     ) -> Tuple[ShardedEntry, List[WriteReq]]:
-        dtype_str = dtype_to_string(arr.dtype)
+        """``array_prepare_func(arr, tracing)`` is the user save-time
+        transform, applied PER LOCAL SHARD at stage time (the reference
+        threads its tensor_prepare_func into the sharded preparer the
+        same way, sharded_tensor.py:133,159) — on TPU essentially all
+        interesting training state is NamedSharding-sharded, so this is
+        the transform's primary audience. The stored dtype is discovered
+        abstractly (``jax.eval_shape`` on the global array, zero FLOPs);
+        subdivision uses the STORED itemsize so blobs honor
+        max_shard_size at their written width."""
+        if array_prepare_traced is not None:
+            dtype_str = array_prepare_traced[0]
+        else:
+            dtype_str, _ = trace_array_prepare(arr, array_prepare_func)
         itemsize = string_to_dtype(dtype_str).itemsize
         max_bytes = get_max_shard_size_bytes()
         global_shape = list(arr.shape)
@@ -166,6 +180,7 @@ class ShardedArrayIOPreparer:
                             data,
                             is_async_snapshot,
                             entry=tensor_entry,
+                            array_prepare_func=array_prepare_func,
                             dedup_entry=prev_shards.get(
                                 (tuple(sub_off), tuple(sub_sz))
                             ),
@@ -355,17 +370,24 @@ class _Assembler:
             # One batched transfer for all of this array's shards (a
             # per-shard loop pays jax dispatch overhead per piece).
             per_device = jax.device_put(bufs, dsts)
+            if obj_out.dtype != per_device[0].dtype:
+                # Reduced-precision save restoring into a full-precision
+                # target: transfer at the STORED width (half the HtoD
+                # bytes), cast on device per single-device piece — the
+                # sharded analog of finalize_into_target's device cast.
+                per_device = [a.astype(obj_out.dtype) for a in per_device]
             self.fut.obj = jax.make_array_from_single_device_arrays(
                 global_shape, obj_out.sharding, per_device
             )
         elif isinstance(obj_out, np.ndarray):
             piece = self.pieces[0]
             if (
-                obj_out.dtype == piece.buf.dtype
-                and obj_out.shape == piece.buf.shape
+                obj_out.shape == piece.buf.shape
                 and obj_out.flags.writeable
             ):
-                np.copyto(obj_out, piece.buf)
+                # Cast into a mismatched-dtype dense target in place
+                # (reference tensor_copy semantics).
+                np.copyto(obj_out, piece.buf, casting="unsafe")
                 self.fut.obj = obj_out
             else:
                 self.fut.obj = piece.buf

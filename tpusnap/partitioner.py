@@ -66,7 +66,7 @@ def estimate_write_loads(
     from .io_preparers.chunked import chunk_row_ranges, should_chunk
     from .io_preparers.sharded import is_sharded
     from .manifest import PrimitiveEntry
-    from .serialization import tensor_nbytes
+    from .serialization import dtype_to_string, tensor_nbytes
 
     candidates = set(replicated_candidates)
     units: List[Tuple[str, int]] = []
@@ -86,13 +86,30 @@ def estimate_write_loads(
         is_array = isinstance(leaf, (jax.Array, np.ndarray))
         if is_array and isinstance(leaf, jax.Array) and is_sharded(leaf):
             # Sharded entries are never replicated-partitioned; their
-            # local shards are this rank's own load.
+            # local shards are this rank's own load — at the STORED
+            # dtype's width when a save-time transform casts them
+            # (trace cached so the sharded preparer doesn't re-trace).
             try:
-                base_load += sum(
+                local_nbytes = sum(
                     s.data.nbytes for s in leaf.addressable_shards
                 )
             except Exception:
-                pass
+                continue
+            try:
+                if array_prepare_func is not None:
+                    dtype, shape = trace_array_prepare(
+                        leaf, functools.partial(array_prepare_func, path)
+                    )
+                    traced_map[path] = (dtype, shape)
+                    stored = tensor_nbytes(dtype, shape)
+                    orig = tensor_nbytes(
+                        dtype_to_string(leaf.dtype), list(leaf.shape)
+                    )
+                    if orig:
+                        local_nbytes = local_nbytes * stored // orig
+            except Exception:
+                pass  # untransformed width is still the right order
+            base_load += local_nbytes
             continue
         # Mirror prepare_write's routing: only supported-dtype arrays
         # reach the array preparers (and hence the save-time transform);

@@ -173,12 +173,14 @@ class Snapshot:
         _custom_array_prepare_func: Optional[Any] = None,
     ) -> "Snapshot":
         """``_custom_array_prepare_func(logical_path, arr, tracing)``
-        transforms dense/chunked arrays at save time (dtype cast /
-        quantize-on-save; reference _custom_tensor_prepare_func,
-        snapshot.py:170-196). At prepare time it is traced abstractly
-        (``jax.eval_shape`` — zero FLOPs) to learn the stored
-        dtype/shape; at stage time it runs for real. It must not change
-        the shape, and must be deterministic.
+        transforms dense, chunked and sharded arrays at save time
+        (dtype cast / quantize-on-save; reference
+        _custom_tensor_prepare_func, snapshot.py:170-196; threaded into
+        the sharded path like reference io_preparer.py:100-106). At
+        prepare time it is traced abstractly (``jax.eval_shape`` — zero
+        FLOPs) to learn the stored dtype/shape; at stage time it runs
+        for real, per local shard for sharded arrays. It must not
+        change the shape, and must be deterministic.
 
         ``incremental_from`` makes this an INCREMENTAL snapshot against a
         previous one at that path (same scheme/bucket; typically a

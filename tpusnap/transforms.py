@@ -36,10 +36,11 @@ def cast_on_save(
     Restoring into a full-precision target upcasts into the target's
     dtype (on device for jax targets).
 
-    Applies to DENSE and CHUNKED arrays only: sharded (multi-device
-    ``NamedSharding``) arrays are written shard-by-shard untransformed
-    — cast those before snapshotting (e.g. keep a bf16 eval copy) if
-    reduced-precision sharded checkpoints are needed."""
+    Applies to dense, chunked AND sharded arrays: multi-device
+    ``NamedSharding`` arrays (DP/FSDP/TP/SP/EP training state — the
+    transform's primary audience on TPU) are cast per local shard at
+    stage time, and restore upcasts into the target's sharding on
+    device."""
     patterns = list(dtype_by_glob.items())
 
     def transform(logical_path: str, arr: Any, tracing: bool) -> Any:
