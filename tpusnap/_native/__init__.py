@@ -190,6 +190,31 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.ts_memcpy_crc_tiles.restype = None
     lib.ts_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32]
     lib.ts_crc32c.restype = ctypes.c_uint32
+    lib.ts_xxh64.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_uint64,
+    ]
+    lib.ts_xxh64.restype = ctypes.c_uint64
+    lib.ts_crc_xxh_tiles.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int,
+    ]
+    lib.ts_crc_xxh_tiles.restype = None
+    lib.ts_memcpy_crc_xxh_tiles.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int,
+    ]
+    lib.ts_memcpy_crc_xxh_tiles.restype = None
     lib.ts_crc32c_combine.argtypes = [
         ctypes.c_uint32,
         ctypes.c_uint32,
@@ -513,6 +538,109 @@ def memcpy_crc_tiles(dst, src, tile_nbytes: int, nthreads: int = 4) -> list:
     lib.ts_memcpy_crc_tiles(dst_ptr, src_ptr, n, tile_nbytes, crcs, nthreads)
     del dst_keep, src_keep
     return list(crcs)
+
+
+def xxh64(buf, seed: int = 0) -> int:
+    """XXH64 of a buffer — the second, independent hash backing
+    incremental-dedup equality (see dedup_hash_algorithm). The fallback
+    is sha256 truncated to 64 bits: a different algorithm, so values are
+    only ever compared under a matching recorded algorithm string."""
+    mv = memoryview(buf).cast("B")
+    lib = _load()
+    if lib is None:
+        return _sha256_64(mv)
+    if mv.nbytes == 0:
+        return lib.ts_xxh64(None, 0, seed)
+    ptr, keepalive = _ptr(mv)
+    out = lib.ts_xxh64(ptr, mv.nbytes, seed)
+    del keepalive
+    return out
+
+
+def _sha256_64(mv) -> int:
+    import hashlib
+
+    return int.from_bytes(hashlib.sha256(mv).digest()[:8], "big")
+
+
+def dedup_hash_algorithm() -> str:
+    return "xxh64" if available() else "sha256-64"
+
+
+def dedup_hash_string(buf) -> str:
+    """``"<algo>:<16-hex>"`` dedup hash of a buffer, for manifest
+    entries. Incremental dedup requires this 64-bit value to match IN
+    ADDITION to the 32-bit CRC — a single CRC leaves a ~2^-32
+    silent-collision channel per blob-take at fleet scale."""
+    return f"{dedup_hash_algorithm()}:{xxh64(buf) & _U64:016x}"
+
+
+_U64 = (1 << 64) - 1
+
+
+def crc_xxh_tiles(buf, tile_nbytes: int, nthreads: int = 4):
+    """Per-``tile_nbytes`` (CRC32C, XXH64) of ``buf`` in ONE fused memory
+    pass — the stage-time hash pass that feeds both the integrity
+    checksums and the dedup hashes. Returns ``(crcs, xxhs)`` lists (one
+    entry each when ``tile_nbytes`` >= the buffer size)."""
+    mv = memoryview(buf).cast("B")
+    n = mv.nbytes
+    if n == 0:
+        return [crc32c(b"")], [xxh64(b"")]
+    if tile_nbytes <= 0 or tile_nbytes > n:
+        tile_nbytes = n
+    n_tiles = (n + tile_nbytes - 1) // tile_nbytes
+    lib = _load()
+    if lib is None:
+        crcs, xxhs = [], []
+        for i in range(n_tiles):
+            sub = mv[i * tile_nbytes : min((i + 1) * tile_nbytes, n)]
+            crcs.append(crc32c(sub))
+            xxhs.append(_sha256_64(sub))
+        return crcs, xxhs
+    crcs = (ctypes.c_uint32 * n_tiles)()
+    xxhs = (ctypes.c_uint64 * n_tiles)()
+    ptr, keepalive = _ptr(mv)
+    lib.ts_crc_xxh_tiles(ptr, n, tile_nbytes, crcs, xxhs, nthreads)
+    del keepalive
+    return list(crcs), list(xxhs)
+
+
+def memcpy_crc_xxh_tiles(dst, src, tile_nbytes: int, nthreads: int = 4):
+    """Copy ``src`` into ``dst`` while computing per-tile (CRC32C, XXH64)
+    — ONE memory pass for what would otherwise be a clone pass plus two
+    hash passes (the async-snapshot staging path). Returns
+    ``(crcs, xxhs)``."""
+    dst_mv = memoryview(dst).cast("B")
+    src_mv = memoryview(src).cast("B")
+    if dst_mv.readonly:
+        raise ValueError("dst must be writable")
+    if dst_mv.nbytes != src_mv.nbytes:
+        raise ValueError(f"size mismatch: {dst_mv.nbytes} != {src_mv.nbytes}")
+    n = src_mv.nbytes
+    if n == 0:
+        return [crc32c(b"")], [xxh64(b"")]
+    if tile_nbytes <= 0 or tile_nbytes > n:
+        tile_nbytes = n
+    n_tiles = (n + tile_nbytes - 1) // tile_nbytes
+    lib = _load()
+    if lib is None:
+        crcs, xxhs = [], []
+        for i in range(n_tiles):
+            sub = src_mv[i * tile_nbytes : min((i + 1) * tile_nbytes, n)]
+            crcs.append(crc32c(sub))
+            xxhs.append(_sha256_64(sub))
+            dst_mv[i * tile_nbytes : i * tile_nbytes + sub.nbytes] = sub
+        return crcs, xxhs
+    crcs = (ctypes.c_uint32 * n_tiles)()
+    xxhs = (ctypes.c_uint64 * n_tiles)()
+    dst_ptr, dst_keep = _ptr(dst_mv)
+    src_ptr, src_keep = _ptr(src_mv)
+    lib.ts_memcpy_crc_xxh_tiles(
+        dst_ptr, src_ptr, n, tile_nbytes, crcs, xxhs, nthreads
+    )
+    del dst_keep, src_keep
+    return list(crcs), list(xxhs)
 
 
 def crc32c(buf, seed: int = 0) -> int:

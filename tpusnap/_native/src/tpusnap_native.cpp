@@ -1044,6 +1044,201 @@ static bool kShiftInit = [] {
   return true;
 }();
 
+// ---------------------------------------------------------------------------
+// XXH64 — the second, independent hash backing incremental-dedup equality.
+//
+// A single 32-bit CRC per blob makes "unchanged" decisions with a ~2^-32
+// silent-collision channel per blob-take (a changed blob whose CRC
+// collides with the base's skips its write and restores stale data, and
+// the scrub passes because the manifest records the colliding value).
+// Dedup therefore requires BOTH the CRC32C and this 64-bit XXH64 to
+// match — independent constructions, ~2^-96 combined. XXH64 (Yann
+// Collet, BSD) is used because it runs near RAM speed on one core,
+// so fusing it into the existing hash pass keeps staging disk-bound.
+
+static const uint64_t kXxhP1 = 11400714785074694791ULL;
+static const uint64_t kXxhP2 = 14029467366897019727ULL;
+static const uint64_t kXxhP3 = 1609587929392839161ULL;
+static const uint64_t kXxhP4 = 9650029242287828579ULL;
+static const uint64_t kXxhP5 = 2870177450012600261ULL;
+
+static inline uint64_t xxh_rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+static inline uint64_t xxh_read64(const char* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+static inline uint32_t xxh_read32(const char* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+static inline uint64_t xxh_round(uint64_t acc, uint64_t lane) {
+  acc += lane * kXxhP2;
+  acc = xxh_rotl64(acc, 31);
+  return acc * kXxhP1;
+}
+static inline uint64_t xxh_merge(uint64_t h, uint64_t v) {
+  h ^= xxh_round(0, v);
+  return h * kXxhP1 + kXxhP4;
+}
+
+// Streaming state: lets the fused tile pass feed 32-byte-aligned blocks
+// while they are still L2-hot from the CRC pass, so RAM is read once.
+struct Xxh64State {
+  uint64_t v1, v2, v3, v4;
+  uint64_t total;
+  explicit Xxh64State(uint64_t seed)
+      : v1(seed + kXxhP1 + kXxhP2),
+        v2(seed + kXxhP2),
+        v3(seed),
+        v4(seed - kXxhP1),
+        total(0) {}
+};
+
+// Consume the longest prefix of whole 32-byte stripes; returns bytes
+// consumed. Interior blocks must be multiples of 32 so no tail buffering
+// is needed between blocks.
+static size_t xxh_consume_stripes(Xxh64State& s, const char* p, size_t n) {
+  size_t consumed = 0;
+  while (n - consumed >= 32) {
+    s.v1 = xxh_round(s.v1, xxh_read64(p + consumed));
+    s.v2 = xxh_round(s.v2, xxh_read64(p + consumed + 8));
+    s.v3 = xxh_round(s.v3, xxh_read64(p + consumed + 16));
+    s.v4 = xxh_round(s.v4, xxh_read64(p + consumed + 24));
+    consumed += 32;
+  }
+  s.total += consumed;
+  return consumed;
+}
+
+static uint64_t xxh_finalize(const Xxh64State& s, uint64_t seed,
+                             const char* tail, size_t tail_n) {
+  const uint64_t total = s.total + tail_n;
+  uint64_t h;
+  if (total >= 32) {
+    h = xxh_rotl64(s.v1, 1) + xxh_rotl64(s.v2, 7) + xxh_rotl64(s.v3, 12) +
+        xxh_rotl64(s.v4, 18);
+    h = xxh_merge(h, s.v1);
+    h = xxh_merge(h, s.v2);
+    h = xxh_merge(h, s.v3);
+    h = xxh_merge(h, s.v4);
+  } else {
+    h = seed + kXxhP5;
+  }
+  h += total;
+  const char* p = tail;
+  size_t n = tail_n;
+  while (n >= 8) {
+    h ^= xxh_round(0, xxh_read64(p));
+    h = xxh_rotl64(h, 27) * kXxhP1 + kXxhP4;
+    p += 8;
+    n -= 8;
+  }
+  if (n >= 4) {
+    h ^= static_cast<uint64_t>(xxh_read32(p)) * kXxhP1;
+    h = xxh_rotl64(h, 23) * kXxhP2 + kXxhP3;
+    p += 4;
+    n -= 4;
+  }
+  while (n > 0) {
+    h ^= static_cast<uint64_t>(static_cast<unsigned char>(*p)) * kXxhP5;
+    h = xxh_rotl64(h, 11) * kXxhP1;
+    ++p;
+    --n;
+  }
+  h ^= h >> 33;
+  h *= kXxhP2;
+  h ^= h >> 29;
+  h *= kXxhP3;
+  h ^= h >> 32;
+  return h;
+}
+
+uint64_t ts_xxh64(const void* buf, size_t n, uint64_t seed) {
+  const char* p = static_cast<const char*>(buf);
+  Xxh64State s(seed);
+  const size_t consumed = xxh_consume_stripes(s, p, n);
+  return xxh_finalize(s, seed, p + consumed, n - consumed);
+}
+
+// Shared inner loop of the fused tile passes: hash one tile with both
+// CRC32C and XXH64, optionally copying it to dst first. Processes
+// 256 KiB blocks so the second hash reads each block while it is still
+// cache-hot from the copy/first hash — one RAM read per byte total.
+static void hash_tile_dual(char* dst, const char* src, size_t len,
+                           uint32_t* crc_out, uint64_t* xxh_out) {
+  const size_t kBlock = 256u << 10;  // multiple of 32 (stripe size)
+  uint32_t crc = 0;
+  Xxh64State s(0);
+  size_t done = 0;
+  while (done < len) {
+    const size_t blk = (len - done < kBlock) ? (len - done) : kBlock;
+    const char* hp = src + done;
+    if (dst != nullptr) {
+      std::memcpy(dst + done, src + done, blk);
+      hp = dst + done;  // hash the copy while it is cache-hot
+    }
+    crc = ts_crc32c(hp, blk, crc);
+    if (done + blk < len) {
+      xxh_consume_stripes(s, hp, blk);  // interior blocks: 32-aligned
+    } else {
+      const size_t c = xxh_consume_stripes(s, hp, blk);
+      *xxh_out = xxh_finalize(s, 0, hp + c, blk - c);
+    }
+    done += blk;
+  }
+  if (len == 0) *xxh_out = xxh_finalize(s, 0, src, 0);
+  *crc_out = crc;
+}
+
+// Per-tile CRC32C + XXH64 of [src, src+n) in one memory pass (dst=NULL),
+// or fused with a clone into dst (the async-snapshot staging path, where
+// the defensive copy, the integrity CRC and the dedup hash would
+// otherwise each read every byte). Tiles parallelize across nthreads.
+static void crc_xxh_tiles_impl(void* dst, const void* src, size_t n,
+                               size_t tile, uint32_t* crcs, uint64_t* xxhs,
+                               int nthreads) {
+  if (n == 0) return;
+  if (tile == 0 || tile > n) tile = n;
+  const size_t n_tiles = (n + tile - 1) / tile;
+  std::atomic<size_t> next{0};
+  auto work = [&] {
+    for (;;) {
+      const size_t i = next.fetch_add(1);
+      if (i >= n_tiles) return;
+      const size_t off = i * tile;
+      const size_t len = (n - off < tile) ? (n - off) : tile;
+      hash_tile_dual(
+          dst == nullptr ? nullptr : static_cast<char*>(dst) + off,
+          static_cast<const char*>(src) + off, len, &crcs[i], &xxhs[i]);
+    }
+  };
+  if (nthreads <= 1 || n_tiles == 1 || n < (8u << 20)) {
+    work();
+    return;
+  }
+  const int nt = (static_cast<size_t>(nthreads) < n_tiles)
+                     ? nthreads
+                     : static_cast<int>(n_tiles);
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (int t = 0; t < nt; ++t) threads.emplace_back(work);
+  for (auto& t : threads) t.join();
+}
+
+void ts_crc_xxh_tiles(const void* src, size_t n, size_t tile, uint32_t* crcs,
+                      uint64_t* xxhs, int nthreads) {
+  crc_xxh_tiles_impl(nullptr, src, n, tile, crcs, xxhs, nthreads);
+}
+
+void ts_memcpy_crc_xxh_tiles(void* dst, const void* src, size_t n, size_t tile,
+                             uint32_t* crcs, uint64_t* xxhs, int nthreads) {
+  crc_xxh_tiles_impl(dst, src, n, tile, crcs, xxhs, nthreads);
+}
+
 uint32_t ts_crc32c_combine(uint32_t crc1, uint32_t crc2, uint64_t len2) {
   (void)kShiftInit;
   if (len2 == 0) return crc1;
