@@ -233,3 +233,94 @@ def test_async_take_fused_clone_checksums_match_sync(tmp_path):
     big_entry = am["0/app/big"]
     assert big_entry.tile_checksums and len(big_entry.tile_checksums) > 1
     assert verify_snapshot(async_path).clean
+
+
+class TestXxh64Native:
+    """The 64-bit dedup hash: native XXH64 against published vectors,
+    fused tile passes against one-shot recomputation, and the fallback's
+    distinct algorithm tag."""
+
+    VECTORS = [  # (input, seed-0 XXH64) — from the xxHash reference
+        (b"", 0xEF46DB3751D8E999),
+        (b"a", 0xD24EC4F1A98C6E5B),
+        (b"abc", 0x44BC2CF5AD770999),
+    ]
+
+    def test_known_vectors(self):
+        from tpusnap import _native
+
+        if not _native.available():
+            import pytest
+
+            pytest.skip("native helper unavailable")
+        for data, expect in self.VECTORS:
+            assert _native.xxh64(data) == expect, data
+
+    def test_fused_tiles_match_one_shot(self):
+        import numpy as np
+
+        from tpusnap import _native
+
+        buf = np.random.default_rng(0).integers(
+            0, 255, 5_000_001, dtype=np.uint8
+        )  # odd length: exercises sub-stripe tails
+        tile = 1 << 20
+        crcs, xxhs = _native.crc_xxh_tiles(buf, tile)
+        dst = np.empty_like(buf)
+        crcs2, xxhs2 = _native.memcpy_crc_xxh_tiles(dst, buf, tile)
+        assert list(crcs) == list(crcs2) and list(xxhs) == list(xxhs2)
+        assert np.array_equal(dst, buf)
+        for i in range(len(xxhs)):
+            sub = buf[i * tile : min((i + 1) * tile, buf.nbytes)]
+            assert _native.crc32c(sub) == crcs[i]
+            assert _native.xxh64(sub) == xxhs[i]
+
+    def test_algorithm_tag_matches_build(self):
+        from tpusnap import _native
+        from tpusnap.knobs import _override_env
+
+        s = _native.dedup_hash_string(b"hello")
+        algo, _, val = s.partition(":")
+        assert algo == _native.dedup_hash_algorithm()
+        assert len(val) == 16 and int(val, 16) >= 0
+
+
+def test_dedup_hashes_sync_async_parity(tmp_path):
+    """Incremental-capable manifests must be byte-identical between the
+    sync hash pass and the async fused clone+hash pass — including the
+    new dedup_hash / tile_dedup_hashes fields."""
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict, verify_snapshot
+    from tpusnap.knobs import (
+        override_batching_disabled,
+        override_record_dedup_hashes,
+        override_tile_checksum_bytes,
+    )
+
+    rng = np.random.default_rng(11)
+    state = {
+        "big": rng.standard_normal((2048, 32)).astype(np.float32),
+        "small": rng.standard_normal(64).astype(np.float32),
+    }
+    with override_batching_disabled(True), override_tile_checksum_bytes(
+        16 * 1024
+    ), override_record_dedup_hashes(True):
+        sync_path = str(tmp_path / "sync")
+        Snapshot.take(sync_path, {"app": StateDict(**state)})
+        async_path = str(tmp_path / "async")
+        Snapshot.async_take(async_path, {"app": StateDict(**state)}).wait()
+    sm = Snapshot(sync_path).get_manifest()
+    am = Snapshot(async_path).get_manifest()
+    checked = 0
+    for p, se in sm.items():
+        ae = am[p]
+        for field in ("checksum", "tile_rows", "tile_checksums",
+                      "dedup_hash", "tile_dedup_hashes"):
+            if hasattr(se, field):
+                assert getattr(se, field) == getattr(ae, field), (p, field)
+                checked += 1
+    assert checked > 0
+    assert sm["0/app/big"].tile_dedup_hashes
+    assert sm["0/app/small"].dedup_hash
+    assert verify_snapshot(async_path).clean

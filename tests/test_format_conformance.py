@@ -211,3 +211,104 @@ def test_unknown_fields_are_ignorable(tmp_path):
     from tpusnap import verify_snapshot
 
     assert verify_snapshot(path).clean
+
+
+def _xxh64_pure(data: bytes, seed: int = 0) -> int:
+    """Independent pure-Python XXH64 (reference algorithm) so the
+    conformance check does not trust the native implementation it is
+    verifying."""
+    M = (1 << 64) - 1
+    P1, P2, P3 = 11400714785074694791, 14029467366897019727, 1609587929392839161
+    P4, P5 = 9650029242287828579, 2870177450012600261
+    rotl = lambda x, r: ((x << r) | (x >> (64 - r))) & M  # noqa: E731
+
+    def rnd(acc, lane):
+        return (rotl((acc + lane * P2) & M, 31) * P1) & M
+
+    n, i = len(data), 0
+    if n >= 32:
+        v = [(seed + P1 + P2) & M, (seed + P2) & M, seed & M, (seed - P1) & M]
+        while n - i >= 32:
+            for k in range(4):
+                v[k] = rnd(v[k], int.from_bytes(data[i + 8 * k : i + 8 * k + 8], "little"))
+            i += 32
+        h = (rotl(v[0], 1) + rotl(v[1], 7) + rotl(v[2], 12) + rotl(v[3], 18)) & M
+        for k in range(4):
+            h = ((h ^ rnd(0, v[k])) * P1 + P4) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while n - i >= 8:
+        h = (rotl(h ^ rnd(0, int.from_bytes(data[i : i + 8], "little")), 27) * P1 + P4) & M
+        i += 8
+    if n - i >= 4:
+        h = (rotl(h ^ (int.from_bytes(data[i : i + 4], "little") * P1) & M, 23) * P2 + P3) & M
+        i += 4
+    while i < n:
+        h = (rotl(h ^ (data[i] * P5) & M, 11) * P1) & M
+        i += 1
+    h ^= h >> 33
+    h = (h * P2) & M
+    h ^= h >> 29
+    h = (h * P3) & M
+    h ^= h >> 32
+    return h
+
+
+def test_dedup_hashes_recomputable_per_spec(tmp_path):
+    """format.md: dedup_hash = "<algo>:<16-hex>" over the same bytes as
+    checksum; xxh64 is seed-0 XXH64, sha256-64 is the first 8 bytes of
+    SHA-256 big-endian; tile_dedup_hashes tile like tile_checksums. An
+    external reader recomputes every recorded value from the raw blob
+    bytes alone."""
+    import hashlib
+
+    from tpusnap.knobs import (
+        override_batching_disabled,
+        override_record_dedup_hashes,
+        override_tile_checksum_bytes,
+    )
+
+    rng = np.random.default_rng(23)
+    state = StateDict(
+        big=rng.standard_normal((512, 32)).astype(np.float32),
+        small=rng.standard_normal(40).astype(np.float32),
+        cfg={"a": [1, 2]},
+    )
+    path = str(tmp_path / "s")
+    with override_batching_disabled(True), override_tile_checksum_bytes(
+        8 * 1024
+    ), override_record_dedup_hashes(True):
+        Snapshot.take(path, {"app": state})
+
+    md = json.loads(open(os.path.join(path, ".snapshot_metadata")).read())
+
+    def recompute(algo: str, raw: bytes) -> str:
+        if algo == "xxh64":
+            return f"{_xxh64_pure(raw):016x}"
+        assert algo == "sha256-64"
+        return hashlib.sha256(raw).digest()[:8].hex()
+
+    checked = 0
+    for key, entry in md["manifest"].items():
+        if entry.get("dedup_hash"):
+            raw = open(os.path.join(path, entry["location"]), "rb").read()
+            if entry.get("byte_range"):
+                s, e = entry["byte_range"]
+                raw = raw[s:e]
+            algo, _, val = entry["dedup_hash"].partition(":")
+            assert val == recompute(algo, raw), key
+            checked += 1
+        if entry.get("tile_dedup_hashes"):
+            raw = open(os.path.join(path, entry["location"]), "rb").read()
+            t = entry["tile_rows"]
+            n_rows = entry["shape"][0]
+            row_nbytes = len(raw) // n_rows
+            for i, th in enumerate(entry["tile_dedup_hashes"]):
+                r0, r1 = i * t, min((i + 1) * t, n_rows)
+                algo, _, val = th.partition(":")
+                assert val == recompute(
+                    algo, raw[r0 * row_nbytes : r1 * row_nbytes]
+                ), (key, i)
+                checked += 1
+    assert checked > 2

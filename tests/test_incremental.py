@@ -7,7 +7,9 @@ Covers: unchanged state writes no data blobs; a changed leaf rewrites
 only itself; restore/read_object/scrub resolve cross-snapshot references;
 chained increments collapse to the oldest base; sharded/chunked/object
 dedup; async incremental takes; deleting the base breaks the increment
-loudly; slab-batched members always rewrite (no slab holes).
+loudly; per-member slab dedup with compaction; tile-grain dedup (one
+changed row rewrites one checksum tile); and the >32-bit dedup-evidence
+rule (CRC + independent 64-bit hash per skip decision).
 """
 
 import os
@@ -178,8 +180,10 @@ def test_deleted_base_breaks_increment_loudly(tmp_path):
         Snapshot(inc).restore(target)
 
 
-def test_slab_members_always_rewrite(tmp_path):
-    """Batched small arrays stage into slabs; dedup must not hole them."""
+def test_slab_integrity_through_dedup(tmp_path):
+    """Batched small arrays stage into slabs; member dedup must never
+    hole a slab — unchanged members reference the base slab, the new
+    slab is compacted, and the whole increment restores + scrubs."""
     st = StateDict(
         a=np.arange(64, dtype=np.float32),
         b=np.arange(64, 128, dtype=np.float32),
@@ -588,3 +592,292 @@ def test_async_incremental_mutation_isolation(tmp_path):
     # the post-return mutation of the live array (which aliases `frozen`,
     # hence the pre-mutation copy).
     assert np.array_equal(target["app"]["frozen"], frozen_orig)
+
+
+# ---------------------------------------------------------------- round 4:
+# tile-grain dedup, slab-member dedup, and >32-bit dedup evidence
+
+
+def _total_blob_bytes(root: str) -> int:
+    return sum(
+        os.path.getsize(os.path.join(root, f)) for f in _blob_files(root)
+    )
+
+
+class TestTileGrainDedup:
+    """Changing one row of a large array rewrites ~one checksum tile,
+    not the whole blob: the incremental take re-chunks the array on the
+    base's tile grid, unchanged tiles become byte-range references into
+    the base blob, and every skip decision compares a 32-bit CRC AND a
+    64-bit hash per tile."""
+
+    SHAPE = (1024, 64)  # f32: 256 KiB; 4 KiB tiles -> 64 tiles of 16 rows
+
+    def _arr(self):
+        return (
+            np.random.default_rng(7)
+            .standard_normal(self.SHAPE)
+            .astype(np.float32)
+        )
+
+    def _ctx(self):
+        from contextlib import ExitStack
+
+        from tpusnap.knobs import (
+            override_record_dedup_hashes,
+            override_tile_checksum_bytes,
+        )
+
+        stack = ExitStack()
+        stack.enter_context(override_tile_checksum_bytes(4 * 1024))
+        # Base takes record tile dedup hashes so the FIRST increment can
+        # already dedup tile-grain.
+        stack.enter_context(override_record_dedup_hashes(True))
+        return stack
+
+    def test_one_changed_row_writes_one_tile(self, tmp_path):
+        arr = self._arr()
+        base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+        with self._ctx():
+            Snapshot.take(base, {"app": StateDict(big=arr)})
+            base_entry = Snapshot(base).metadata.manifest["0/app/big"]
+            assert base_entry.tile_dedup_hashes  # forced by the knob
+            changed = arr.copy()
+            changed[500, :] += 1.0  # one row -> one 16-row tile
+            Snapshot.take(
+                inc, {"app": StateDict(big=changed)}, incremental_from=base
+            )
+        # Only ~one tile (4 KiB) of new data, not the 256 KiB blob.
+        written = _total_blob_bytes(inc)
+        assert 0 < written <= 2 * 4 * 1024, written
+        e = Snapshot(inc).metadata.manifest["0/app/big"]
+        assert e.type == "ChunkedTensor"
+        ext = [c for c in e.chunks if c.tensor.location.startswith("../")]
+        assert len(ext) == len(e.chunks) - 1  # all but the changed tile
+        # Restore, scrub, and read_object all resolve the mixed form.
+        target = {"app": StateDict(big=np.zeros(self.SHAPE, np.float32))}
+        Snapshot(inc).restore(target)
+        assert np.array_equal(target["app"]["big"], changed)
+        assert verify_snapshot(inc).clean
+        out = Snapshot(inc).read_object("0/app/big")
+        assert np.array_equal(out, changed)
+
+    def test_chain_stays_tile_grain_and_collapses(self, tmp_path):
+        """The 2nd increment dedups against the 1st's CHUNKED entry and
+        references collapse to the oldest base that owns each tile."""
+        arr = self._arr()
+        s0, s1, s2 = (str(tmp_path / f"s{i}") for i in range(3))
+        with self._ctx():
+            Snapshot.take(s0, {"app": StateDict(big=arr)})
+            c1 = arr.copy()
+            c1[500, :] += 1.0
+            Snapshot.take(s1, {"app": StateDict(big=c1)}, incremental_from=s0)
+            c2 = c1.copy()
+            c2[10, :] -= 2.0
+            Snapshot.take(s2, {"app": StateDict(big=c2)}, incremental_from=s1)
+        written = _total_blob_bytes(s2)
+        assert 0 < written <= 2 * 4 * 1024, written
+        e = Snapshot(s2).metadata.manifest["0/app/big"]
+        locs = {c.tensor.location.split("/")[1] if c.tensor.location.startswith("..") else "local" for c in e.chunks}
+        # Tiles reference s0 (unchanged since base), s1 (row 500), and
+        # one local write (row 10) — chained refs collapsed, not s1-only.
+        assert "s0" in locs and "s1" in locs and "local" in locs
+        target = {"app": StateDict(big=np.zeros(self.SHAPE, np.float32))}
+        Snapshot(s2).restore(target)
+        assert np.array_equal(target["app"]["big"], c2)
+        assert verify_snapshot(s2).clean
+
+    def test_diff_decides_across_geometries(self, tmp_path):
+        """diff(base, tile-grain increment): unchanged paths identical,
+        the changed path provably changed — not undecidable — even
+        though the increment stores a chunked geometry."""
+        from tpusnap.inspect import diff_snapshots
+
+        arr = self._arr()
+        other = np.arange(32, dtype=np.int32)
+        base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+        with self._ctx():
+            Snapshot.take(base, {"app": StateDict(big=arr, other=other)})
+            changed = arr.copy()
+            changed[0, 0] += 1.0
+            Snapshot.take(
+                inc,
+                {"app": StateDict(big=changed, other=other)},
+                incremental_from=base,
+            )
+        d = diff_snapshots(base, inc)
+        assert "0/app/big" in d.changed
+        assert "0/app/other" in d.identical
+        assert not d.unknown
+
+    def test_materialize_tile_grain_increment(self, tmp_path):
+        arr = self._arr()
+        base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+        with self._ctx():
+            Snapshot.take(base, {"app": StateDict(big=arr)})
+            changed = arr.copy()
+            changed[123, :] *= 3.0
+            Snapshot.take(
+                inc, {"app": StateDict(big=changed)}, incremental_from=base
+            )
+        from tpusnap.inspect import materialize_snapshot
+
+        stats = materialize_snapshot(inc)
+        assert stats["blobs_copied"] >= 1
+        import shutil
+
+        shutil.rmtree(base)
+        target = {"app": StateDict(big=np.zeros(self.SHAPE, np.float32))}
+        Snapshot(inc).restore(target)
+        assert np.array_equal(target["app"]["big"], changed)
+        assert verify_snapshot(inc).clean
+
+    def test_tile_route_needs_prev_tile_hashes(self, tmp_path):
+        """A base WITHOUT tile dedup hashes (plain take) cannot back
+        tile-grain skips — the increment falls back to whole-blob
+        dedup/rewrite and stays correct."""
+        from tpusnap.knobs import override_tile_checksum_bytes
+
+        arr = self._arr()
+        base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+        with override_tile_checksum_bytes(4 * 1024):
+            Snapshot.take(base, {"app": StateDict(big=arr)})  # no hashes
+            assert (
+                Snapshot(base).metadata.manifest["0/app/big"].tile_dedup_hashes
+                is None
+            )
+            changed = arr.copy()
+            changed[500, :] += 1.0
+            Snapshot.take(
+                inc, {"app": StateDict(big=changed)}, incremental_from=base
+            )
+        # Whole blob rewrote (safe fallback)...
+        assert _total_blob_bytes(inc) >= arr.nbytes
+        # ...and the rewrite recorded tile hashes (incremental take), so
+        # the NEXT increment reaches tile grain.
+        inc2 = str(tmp_path / "s2")
+        with override_tile_checksum_bytes(4 * 1024):
+            c2 = changed.copy()
+            c2[1, :] = 0.0
+            Snapshot.take(inc2, {"app": StateDict(big=c2)}, incremental_from=inc)
+        assert 0 < _total_blob_bytes(inc2) <= 2 * 4 * 1024
+
+
+class TestSlabMemberDedup:
+    """Small slab-batched arrays dedup per member: unchanged members
+    re-point at the base slab's byte ranges, the new slab holds only
+    changed members (compacted), and a fully-unchanged slab writes
+    nothing."""
+
+    def _state(self, bump: float = 0.0):
+        rng = np.random.default_rng(3)
+        st = {
+            f"p{i}": rng.standard_normal(256).astype(np.float32)
+            for i in range(6)
+        }
+        if bump:
+            st["p3"] = st["p3"] + bump
+        return StateDict(**st)
+
+    def test_one_changed_member_compacts_slab(self, tmp_path):
+        base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+        Snapshot.take(base, {"app": self._state()})
+        Snapshot.take(
+            inc, {"app": self._state(bump=1.0)}, incremental_from=base
+        )
+        # New slab holds exactly the changed member's bytes.
+        assert _total_blob_bytes(inc) == 256 * 4
+        m = Snapshot(inc).metadata.manifest
+        ext = [k for k in m if k.startswith("0/app/p") and m[k].location.startswith("../")]
+        assert len(ext) == 5
+        assert m["0/app/p3"].byte_range == [0, 1024]  # compacted offset
+        target = {"app": self._state() }
+        expect = self._state(bump=1.0)
+        Snapshot(inc).restore(target)
+        for k in expect:
+            assert np.array_equal(target["app"][k], expect[k]), k
+        assert verify_snapshot(inc).clean
+
+    def test_unchanged_slab_writes_nothing(self, tmp_path):
+        base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+        Snapshot.take(base, {"app": self._state()})
+        Snapshot.take(inc, {"app": self._state()}, incremental_from=base)
+        assert _blob_files(inc) == []
+        assert verify_snapshot(inc).clean
+
+    def test_device_batched_members_dedup(self, tmp_path):
+        """jax.Array members take the device-packing path; dedup and
+        compaction must work there too."""
+        import jax.numpy as jnp
+
+        def state(bump=0.0):
+            vals = {
+                f"p{i}": jnp.asarray(
+                    np.arange(i * 100, i * 100 + 128, dtype=np.float32)
+                )
+                for i in range(4)
+            }
+            if bump:
+                vals["p1"] = vals["p1"] + bump
+            return StateDict(**vals)
+
+        base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+        Snapshot.take(base, {"app": state()})
+        Snapshot.take(inc, {"app": state(bump=2.0)}, incremental_from=base)
+        assert _total_blob_bytes(inc) == 128 * 4
+        target = {"app": state()}
+        Snapshot(inc).restore(target)
+        assert np.allclose(np.asarray(target["app"]["p1"]),
+                           np.arange(100, 228, dtype=np.float32) + 2.0)
+        assert verify_snapshot(inc).clean
+
+    def test_member_without_base_hash_rewrites(self, tmp_path):
+        """Strip the base members' dedup hashes (simulating an old-format
+        base): a single matching 32-bit CRC is NOT enough evidence, so
+        members conservatively rewrite."""
+        import json
+
+        base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+        Snapshot.take(base, {"app": self._state()})
+        md_path = os.path.join(base, ".snapshot_metadata")
+        md = json.loads(open(md_path).read())
+        for entry in md["manifest"].values():
+            entry.pop("dedup_hash", None)
+        with open(md_path, "w") as f:
+            f.write(json.dumps(md))
+        Snapshot.take(inc, {"app": self._state()}, incremental_from=base)
+        assert _total_blob_bytes(inc) == 6 * 256 * 4  # full rewrite, safe
+        assert verify_snapshot(inc).clean
+
+
+def test_dedup_match_requires_64bit_evidence():
+    """Unit pin of the ADVICE r3 fix: two tile-less entries agreeing on
+    the 32-bit CRC but differing in (or missing) the 64-bit dedup hash
+    must NOT dedup; tiled entries must agree on every tile CRC and, when
+    present, every tile hash."""
+    from tpusnap.io_preparers.array import dedup_entries_match
+    from tpusnap.manifest import TensorEntry
+
+    def te(**kw):
+        base = dict(
+            location="x", serializer="buffer_protocol", dtype="float32",
+            shape=[4], replicated=False, checksum="crc32c:00000001",
+        )
+        base.update(kw)
+        return TensorEntry(**base)
+
+    a = te(dedup_hash="xxh64:00000000000000aa")
+    assert dedup_entries_match(a, te(dedup_hash="xxh64:00000000000000aa"))
+    # CRC collides, 64-bit hash differs -> changed blob detected.
+    assert not dedup_entries_match(a, te(dedup_hash="xxh64:00000000000000bb"))
+    # Either side missing the hash -> no dedup (old-format base).
+    assert not dedup_entries_match(a, te())
+    assert not dedup_entries_match(te(), te())
+    # Tiled entries: multiple independent CRCs suffice...
+    t1 = te(tile_rows=2, tile_checksums=["crc32c:01", "crc32c:02"])
+    t2 = te(tile_rows=2, tile_checksums=["crc32c:01", "crc32c:02"])
+    assert dedup_entries_match(t1, t2)
+    # ...but matching tile hashes bind when both sides carry them.
+    t1.tile_dedup_hashes = ["xxh64:0a", "xxh64:0b"]
+    t2.tile_dedup_hashes = ["xxh64:0a", "xxh64:0c"]
+    assert not dedup_entries_match(t1, t2)

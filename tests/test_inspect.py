@@ -333,7 +333,10 @@ def test_diff_undecidable_cases(tmp_path, capsys):
     assert cli_main(["diff", "-q", a, b]) == 3
     capsys.readouterr()
 
-    # Same bytes, different chunk geometry: undecidable, not changed.
+    # Same bytes, different chunk geometry: row-chunk checksums FOLD to
+    # the whole-array value (CRC combine), so this is provably identical
+    # — tile-grain incremental takes re-chunk arrays on the base's tile
+    # grid and must still diff as identical, not undecidable.
     big = np.random.default_rng(0).standard_normal((64, 64)).astype(np.float32)
     c1, c2 = str(tmp_path / "c1"), str(tmp_path / "c2")
     with override_batching_disabled(True):
@@ -342,7 +345,17 @@ def test_diff_undecidable_cases(tmp_path, capsys):
         with override_max_chunk_size_bytes(2 * 1024):
             Snapshot.take(c2, {"app": StateDict(big=big)})
     d = diff_snapshots(c1, c2)
-    assert "0/app/big" in d.unknown and not d.differs
+    assert "0/app/big" in d.identical and not d.differs
+    # ...and a changed value across different chunk geometries is
+    # provably CHANGED, not undecidable.
+    big2 = big.copy()
+    big2[17, 3] += 1.0
+    c2b = str(tmp_path / "c2b")
+    with override_batching_disabled(True):
+        with override_max_chunk_size_bytes(2 * 1024):
+            Snapshot.take(c2b, {"app": StateDict(big=big2)})
+    d = diff_snapshots(c1, c2b)
+    assert "0/app/big" in d.changed
 
     # Different dtype at the same path: provably changed even across
     # layouts.
@@ -351,3 +364,95 @@ def test_diff_undecidable_cases(tmp_path, capsys):
         Snapshot.take(c3, {"app": StateDict(big=big.astype(np.float64))})
     d = diff_snapshots(c1, c3)
     assert "0/app/big" in d.changed
+
+
+# ------------------------------------------------------------- round 4:
+# ADVICE fixes — verify exit 3, recorded base roots, async_restore guard
+
+
+def test_cli_verify_exit3_when_nothing_verifiable(tmp_path, capsys):
+    """`verify` exiting 0 when every blob is UNVERIFIED would let
+    scripts mistake 'nothing was checkable' for 'verified clean'
+    (ADVICE r3): a checksum-less snapshot must exit 3, mirroring diff's
+    undecidable convention."""
+    from tpusnap.knobs import override_checksum_disabled
+
+    path = str(tmp_path / "s")
+    with override_checksum_disabled(True):
+        Snapshot.take(path, {"app": StateDict(w=np.arange(64, dtype=np.float32))})
+    assert cli_main(["verify", path]) == 3
+    err = capsys.readouterr().err
+    assert "nothing verified" in err
+    # A normal snapshot still exits 0 (and a corrupt one 2 — covered by
+    # test_cli_info_ls_cat_verify).
+    good = str(tmp_path / "g")
+    Snapshot.take(good, {"app": StateDict(w=np.arange(64, dtype=np.float32))})
+    capsys.readouterr()
+    assert cli_main(["verify", good]) == 0
+
+
+def test_base_roots_recorded_and_resolve_numeric_dirs(tmp_path):
+    """A base path with a purely NUMERIC intermediate directory
+    ("exp/1000/final") defeats grammar parsing (ADVICE r3) — the take
+    now records metadata.base_roots, and retention/info/materialize
+    resolve through it instead of guessing."""
+    from tpusnap.inspect import base_root_of_location
+    from tpusnap.retention import _referenced_bases
+
+    base = str(tmp_path / "exp" / "1000" / "final")
+    inc = str(tmp_path / "exp" / "1000" / "cont")
+    st = StateDict(w=np.random.default_rng(0).standard_normal(4096).astype(np.float32))
+    Snapshot.take(base, {"app": st})
+    Snapshot.take(inc, {"app": st}, incremental_from=base)
+    md = Snapshot(inc).metadata
+    assert md.base_roots == ["../final"]
+    # Grammar parsing alone is fooled by the advisor's exact hazard — a
+    # MULTI-segment base path with an interior numeric directory — while
+    # the recorded roots resolve it exactly.
+    loc = "../exp/1000/final/0/w"
+    assert base_root_of_location(loc) == "../exp"  # grammar guesses wrong
+    assert (
+        base_root_of_location(loc, known_roots=["../exp/1000/final"])
+        == "../exp/1000/final"
+    )
+    # retention resolves through the recorded roots.
+    bases = _referenced_bases(inc)
+    assert bases == [os.path.abspath(base)]
+    # materialize clears base_roots once self-contained.
+    from tpusnap.inspect import materialize_snapshot
+
+    materialize_snapshot(inc)
+    assert Snapshot(inc).metadata.base_roots is None
+    assert verify_snapshot(inc).clean
+
+
+def test_chained_base_roots_accumulate(tmp_path):
+    """A chain's 2nd increment references BOTH earlier snapshots; its
+    recorded roots must list each one it actually points into."""
+    s0, s1, s2 = (str(tmp_path / f"step_{i}") for i in range(3))
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal(4096).astype(np.float32)
+    b = rng.standard_normal(4096).astype(np.float32)
+    Snapshot.take(s0, {"app": StateDict(a=a, b=b)})
+    Snapshot.take(s1, {"app": StateDict(a=a, b=b + 1)}, incremental_from=s0)
+    Snapshot.take(s2, {"app": StateDict(a=a, b=b + 1)}, incremental_from=s1)
+    md = Snapshot(s2).metadata
+    assert md.base_roots == ["../step_0", "../step_1"]
+
+
+def test_async_restore_rejects_collective_stateful(tmp_path):
+    """A stateful declaring load_requires_collectives=True must be
+    rejected by async_restore (collectives on the background thread run
+    unordered across ranks) and still restore fine synchronously."""
+    import pytest
+
+    class CollectiveStateful(StateDict):
+        load_requires_collectives = True
+
+    path = str(tmp_path / "s")
+    Snapshot.take(path, {"m": CollectiveStateful(w=np.arange(8, dtype=np.float32))})
+    target = {"m": CollectiveStateful(w=np.zeros(8, np.float32))}
+    with pytest.raises(ValueError, match="load_requires_collectives"):
+        Snapshot(path).async_restore(target)
+    Snapshot(path).restore(target, per_key_barrier=True)
+    assert np.array_equal(target["m"]["w"], np.arange(8, dtype=np.float32))

@@ -56,7 +56,15 @@ def _batchable_tensor_entries(entries: List[Entry]) -> Dict[str, TensorEntry]:
 
 class BatchedBufferStager(BufferStager):
     """Stages all members concurrently into one contiguous bytearray
-    (reference BatchedBufferStager, batcher.py:48-98)."""
+    (reference BatchedBufferStager, batcher.py:48-98).
+
+    Members carry their own incremental-dedup state: a member whose
+    stager reports SKIP_WRITE (bytes match the base snapshot — its entry
+    already re-pointed at the base slab's byte range) is EXCLUDED from
+    the new slab, and the remaining members are compacted (entries'
+    byte ranges reassigned). A fully-deduped slab skips its write
+    entirely. Small states therefore stop rewriting 100% on every
+    incremental take."""
 
     def __init__(self, members: List[Tuple[int, int, BufferStager]]) -> None:
         # members: [(offset, nbytes, stager)]
@@ -65,12 +73,22 @@ class BatchedBufferStager(BufferStager):
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         from . import _native
+        from .io_types import SKIP_WRITE
 
         # Aligned so the O_DIRECT writer pwrites straight from the slab.
+        # Full-size upfront: members stream into their original offsets
+        # as they land (each member's buffer is released immediately —
+        # peak memory stays one slab + one member, matching
+        # get_staging_cost_bytes); dedup'd members leave holes that one
+        # in-place compaction pass closes at the end.
         slab = _native.aligned_empty(self.total)
+        skipped = [False] * len(self.members)
 
-        async def fill(offset: int, nbytes: int, stager: BufferStager) -> None:
+        async def fill(i: int, offset: int, nbytes: int, stager: BufferStager) -> None:
             buf = await stager.stage_buffer(executor)
+            if buf is SKIP_WRITE:
+                skipped[i] = True  # member dedup'd against the base
+                return
             mv = memoryview(buf).cast("B")
             if mv.nbytes != nbytes:
                 raise RuntimeError(
@@ -78,8 +96,34 @@ class BatchedBufferStager(BufferStager):
                 )
             slab[offset : offset + nbytes] = np.frombuffer(mv, dtype=np.uint8)
 
-        await asyncio.gather(*(fill(o, n, s) for o, n, s in self.members))
-        return slab
+        await asyncio.gather(
+            *(fill(i, o, n, s) for i, (o, n, s) in enumerate(self.members))
+        )
+        if not any(skipped):
+            return slab
+        # Compact in place around the dedup'd members (memmove — source
+        # and destination overlap when moving left; numpy slice
+        # assignment does not guarantee overlap safety) and return a
+        # view of the kept prefix — no second allocation.
+        import ctypes
+
+        new_offset = 0
+        for i, (offset, nbytes, stager) in enumerate(self.members):
+            if skipped[i]:
+                continue
+            if new_offset != offset:
+                ctypes.memmove(
+                    slab.ctypes.data + new_offset,
+                    slab.ctypes.data + offset,
+                    nbytes,
+                )
+            entry = getattr(stager, "entry", None)
+            if entry is not None:
+                entry.byte_range = [new_offset, new_offset + nbytes]
+            new_offset += nbytes
+        if new_offset == 0:
+            return SKIP_WRITE
+        return slab[:new_offset]
 
     def get_staging_cost_bytes(self) -> int:
         # The slab plus transiently one member's own staging cost; the
@@ -141,19 +185,69 @@ class DeviceBatchedBufferStager(BufferStager):
             raise RuntimeError(
                 f"device-packed slab is {host.nbytes} bytes, expected {self.total}"
             )
-        if not is_checksum_disabled():
-            # The members' own stagers are bypassed by the device-side
-            # pack, so record their checksums from the slab slices here.
-            from . import _native
+        if is_checksum_disabled():
+            return host
+        # The members' own stagers are bypassed by the device-side pack,
+        # so record their checksums/dedup hashes from the slab slices
+        # here — the same _record_checksums the host path runs, so both
+        # paths produce identical manifests. Members matching their base
+        # entry (incremental dedup) are dropped and the slab compacted,
+        # exactly like BatchedBufferStager. (The packed slab is a fresh
+        # XLA result, so member bytes are stable — no clone needed.)
+        from .io_preparers.array import _record_checksums, dedup_entries_match
+        from .io_types import SKIP_WRITE
 
-            for offset, nbytes, stager in self.members:
-                if stager.entry is not None:
-                    stager.entry.checksum = _native.checksum_string(
-                        host[offset : offset + nbytes]
-                    )
-        return host
+        keep: List[Tuple[int, int]] = []  # (old_offset, nbytes)
+        keep_stagers: List[ArrayBufferStager] = []
+        for offset, nbytes, stager in self.members:
+            if stager.entry is None:
+                keep.append((offset, nbytes))
+                keep_stagers.append(stager)
+                continue
+            mv = memoryview(host[offset : offset + nbytes])
+            _record_checksums(
+                stager.entry, mv, getattr(stager, "record_dedup_hashes", False)
+            )
+            dedup = getattr(stager, "dedup_entry", None)
+            if dedup is not None and dedup_entries_match(stager.entry, dedup):
+                stager.entry.location = dedup.location
+                stager.entry.byte_range = (
+                    list(dedup.byte_range)
+                    if dedup.byte_range is not None
+                    else None
+                )
+                continue
+            keep.append((offset, nbytes))
+            keep_stagers.append(stager)
+        if not keep:
+            return SKIP_WRITE
+        if len(keep) == len(self.members):
+            return host
+        from . import _native
+
+        # Aligned so the O_DIRECT writer pwrites straight from it (the
+        # host-path slab is allocated the same way).
+        out = _native.aligned_empty(sum(n for _, n in keep))
+        new_offset = 0
+        for (old_offset, nbytes), stager in zip(keep, keep_stagers):
+            out[new_offset : new_offset + nbytes] = host[
+                old_offset : old_offset + nbytes
+            ]
+            if stager.entry is not None:
+                stager.entry.byte_range = [new_offset, new_offset + nbytes]
+            new_offset += nbytes
+        return out
 
     def get_staging_cost_bytes(self) -> int:
+        # Partial dedup holds the DMA'd slab AND the compacted copy at
+        # once (the DMA result may alias XLA-owned memory, so unlike the
+        # host path it cannot compact in place): budget 2x whenever a
+        # member might dedup.
+        if any(
+            getattr(s, "dedup_entry", None) is not None
+            for _, _, s in self.members
+        ):
+            return 2 * self.total
         return self.total
 
 
@@ -267,12 +361,10 @@ def batch_write_requests(
             ):
                 tensor_entry.location = location
                 tensor_entry.byte_range = [member_offset, member_offset + nbytes]
-                # Slab members stage INTO the slab; a member skipping its
-                # write (incremental dedup) would hole the slab, so
-                # members always rewrite. (Blobs above the slab threshold
-                # and all shards/chunks never batch and dedup normally.)
-                if hasattr(stager, "dedup_entry"):
-                    stager.dedup_entry = None
+                # Members keep their dedup state: one that matches its
+                # base entry skips (its entry re-pointed at the base
+                # slab's byte range) and the stager compacts the slab
+                # around it at stage time.
             stager_cls = (
                 DeviceBatchedBufferStager
                 if slab_device is not None

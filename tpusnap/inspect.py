@@ -50,15 +50,34 @@ __all__ = [
 ]
 
 
-def base_root_of_location(location: str) -> str:
+def base_root_of_location(
+    location: str, known_roots: Optional[List[str]] = None
+) -> str:
     """Base-snapshot root (relative to the referencing snapshot) of an
-    external blob location: everything before the storage-layout segment
-    (``<rank>/``, ``replicated/``, ``sharded/``, ``batched/``) that
-    starts the blob's path within its own snapshot. The first segment
-    after the leading ``..`` run always belongs to the base path (a
-    relative reference descends into the base's directory name), so a
-    base named by a bare step number ("../1000/0/app/w") parses
-    correctly."""
+    external blob location.
+
+    ``known_roots`` — the referencing snapshot's recorded
+    ``metadata.base_roots`` — is authoritative: the longest root that
+    prefixes ``location`` wins, with no guessing. Locations matching no
+    known root (older-format snapshots) fall back to grammar parsing:
+    everything before the storage-layout segment (``<rank>/``,
+    ``replicated/``, ``sharded/``, ``batched/``) that starts the blob's
+    path within its own snapshot. The first segment after the leading
+    ``..`` run always belongs to the base path (a relative reference
+    descends into the base's directory name), so a base named by a bare
+    step number ("../1000/0/app/w") parses correctly — but a MULTI-level
+    base path with an interior numeric directory ("../exp/1000/final" in
+    "../exp/1000/final/0/w") is ambiguous to the grammar, which is why
+    writers record base_roots (ADVICE r3)."""
+    if known_roots:
+        best = None
+        for r in known_roots:
+            if (location == r or location.startswith(r + "/")) and (
+                best is None or len(r) > len(best)
+            ):
+                best = r
+        if best is not None:
+            return best
     segs = location.split("/")
     i = 0
     while i < len(segs) and segs[i] == "..":
@@ -285,7 +304,9 @@ def materialize_snapshot(
                 for t in _entry_tensors(entry):
                     if not t.location.startswith("../"):
                         continue
-                    base = base_root_of_location(t.location)
+                    base = base_root_of_location(
+                        t.location, metadata.base_roots
+                    )
                     local = t.location[len(base) + 1 :]
                     prior = local_for.setdefault(t.location, local)
                     if prior != local:  # pragma: no cover - defensive
@@ -352,12 +373,17 @@ def materialize_snapshot(
 
             from .snapshot import SNAPSHOT_METADATA_FNAME
 
+            metadata.base_roots = None  # self-contained now
+            # durable=True: this REWRITES an already-committed snapshot's
+            # metadata — power loss must never tear or lose it (fsync is
+            # cheap here; no multi-GB take preceded it).
             storage.sync_write_atomic(
                 WriteIO(
                     path=SNAPSHOT_METADATA_FNAME,
                     buf=metadata.to_yaml().encode("utf-8"),
                 ),
                 event_loop,
+                durable=True,
             )
         finally:
             if owns_resources:
@@ -400,13 +426,60 @@ class SnapshotDiff:
         )
 
 
+def _rowwise_fold(entry) -> Optional[str]:
+    """Whole-array checksum of a dense or chunked tensor entry, derived
+    by CRC combine over in-order row chunks when necessary — so the same
+    content stored in DIFFERENT row-chunk geometries (a tile-grain
+    incremental take re-chunks an array on the base's checksum-tile
+    grid) still compares equal. None when not derivable (missing
+    checksums, non-row chunking, or a checksum algorithm this build
+    cannot combine)."""
+    from . import _native
+
+    algo = _native.checksum_algorithm()
+    if isinstance(entry, TensorEntry):
+        if entry.checksum and entry.checksum.startswith(algo + ":"):
+            return entry.checksum
+        return None
+    if not isinstance(entry, ChunkedTensorEntry) or not entry.chunks:
+        return None
+    row_nbytes = (
+        tensor_nbytes(entry.dtype, entry.shape[1:])
+        if len(entry.shape) > 1
+        else tensor_nbytes(entry.dtype, [1])
+    )
+    chunks = sorted(entry.chunks, key=lambda c: c.offsets[0])
+    expect = 0
+    folded: Optional[int] = None
+    for c in chunks:
+        if (
+            c.offsets[0] != expect
+            or any(o != 0 for o in c.offsets[1:])
+            or list(c.sizes[1:]) != list(entry.shape[1:])
+            or not c.tensor.checksum
+            or not c.tensor.checksum.startswith(algo + ":")
+        ):
+            return None
+        val = int(c.tensor.checksum.partition(":")[2], 16)
+        n = c.sizes[0] * row_nbytes
+        folded = val if folded is None else _native.crc_combine(folded, val, n)
+        expect += c.sizes[0]
+    if expect != entry.shape[0] or folded is None:
+        return None
+    return f"{algo}:{folded & 0xFFFFFFFF:08x}"
+
+
 def _entry_fingerprint(entry: Entry):
     """(identity, geometry, content) of a leaf entry.
 
     - ``identity``: what the value IS (dtype/shape or object type) — an
       identity mismatch is a real change regardless of layout.
     - ``geometry``: how it was stored (dense/chunked/sharded + boxes) —
-      checksums are only comparable between equal geometries.
+      checksums are only comparable between equal geometries. Dense and
+      row-chunked entries whose checksums fold to a whole-array value
+      normalize to the SAME ("rows",) geometry, so a tile-grain
+      incremental take (which re-chunks on the tile grid) diffs as
+      identical/changed against its dense base instead of undecidable.
     - ``content``: the recorded checksums, or None when absent.
 
     Locations are excluded throughout — a blob that moved (slab
@@ -414,6 +487,14 @@ def _entry_fingerprint(entry: Entry):
     same content."""
     if isinstance(entry, PrimitiveEntry):
         return (("prim", entry.dtype), (), entry.serialized_value)
+    if isinstance(entry, (TensorEntry, ChunkedTensorEntry)):
+        folded = _rowwise_fold(entry)
+        if folded is not None:
+            return (
+                ("tensor", entry.dtype, tuple(entry.shape)),
+                ("rows",),
+                folded,
+            )
     if isinstance(entry, TensorEntry):
         return (
             ("tensor", entry.dtype, tuple(entry.shape)),

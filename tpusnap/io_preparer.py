@@ -55,6 +55,8 @@ def prepare_write(
     array_prepare_func: Optional[Any] = None,
     array_prepare_traced: Optional[Tuple[str, Any]] = None,
     prev_entry: Optional[Entry] = None,
+    record_dedup_hashes: bool = False,
+    allow_tile_dedup: bool = True,
 ) -> Tuple[Entry, List[WriteReq]]:
     """``array_prepare_func(arr, tracing) -> arr`` is the user save-time
     transform (reference _custom_tensor_prepare_func, snapshot.py:
@@ -69,7 +71,15 @@ def prepare_write(
     ``prev_entry`` is the previous snapshot's entry for this logical path
     (locations rewritten relative to the new snapshot root) for
     incremental-snapshot dedup: blobs whose staged bytes hash identically
-    skip their writes and reference the previous snapshot's blob."""
+    skip their writes and reference the previous snapshot's blob.
+    ``record_dedup_hashes`` (incremental takes) records 64-bit per-tile
+    dedup hashes so later increments can skip at TILE grain; when
+    ``prev_entry`` carries a usable tile map, the dense/chunked write is
+    re-chunked on the previous take's checksum-tile grid and each tile
+    dedups independently — one changed row of a multi-GB array rewrites
+    one tile, not the blob. ``allow_tile_dedup=False`` disables that
+    re-chunking (multi-process replicated entries: the write-load
+    estimator's unit ids must stay blob-grain on every rank)."""
     if PrimitiveEntry.supported(obj):
         return PrimitiveEntry.from_object(obj, replicated=replicated), []
 
@@ -85,10 +95,38 @@ def prepare_write(
             array_prepare_func=array_prepare_func,
             array_prepare_traced=array_prepare_traced,
             prev_entry=prev_entry,
+            record_dedup_hashes=record_dedup_hashes,
         )
 
     if isinstance(obj, (jax.Array, np.ndarray)) and is_supported_array_dtype(obj):
         storage_path = get_storage_path(logical_path, rank, replicated, sharded=False)
+        if prev_entry is not None and allow_tile_dedup:
+            # Tile-grain incremental route: re-chunk on the previous
+            # take's checksum-tile grid so each tile skips or writes
+            # independently (byte-range references into the base blob
+            # for unchanged tiles).
+            from .io_preparers.chunked import tile_prev_map
+            from .io_preparers.array import trace_array_prepare
+
+            if array_prepare_traced is not None:
+                dtype, shape = array_prepare_traced[0], list(array_prepare_traced[1])
+            else:
+                dtype, shape = trace_array_prepare(obj, array_prepare_func)
+                array_prepare_traced = (dtype, shape)
+            tiled_prev = tile_prev_map(prev_entry, dtype, shape)
+            if tiled_prev is not None:
+                grid_rows, prev_tiles = tiled_prev
+                return ChunkedArrayIOPreparer.prepare_write(
+                    storage_path,
+                    obj,
+                    replicated,
+                    is_async_snapshot,
+                    array_prepare_func=array_prepare_func,
+                    array_prepare_traced=array_prepare_traced,
+                    record_dedup_hashes=record_dedup_hashes,
+                    chunk_rows=grid_rows,
+                    prev_chunks=prev_tiles,
+                )
         if should_chunk(obj):
             return ChunkedArrayIOPreparer.prepare_write(
                 storage_path,
@@ -98,6 +136,7 @@ def prepare_write(
                 array_prepare_func=array_prepare_func,
                 array_prepare_traced=array_prepare_traced,
                 prev_entry=prev_entry,
+                record_dedup_hashes=record_dedup_hashes,
             )
         return ArrayIOPreparer.prepare_write(
             storage_path,
@@ -107,6 +146,7 @@ def prepare_write(
             array_prepare_func=array_prepare_func,
             array_prepare_traced=array_prepare_traced,
             prev_entry=prev_entry,
+            record_dedup_hashes=record_dedup_hashes,
         )
 
     storage_path = get_storage_path(logical_path, rank, replicated, sharded=False)

@@ -84,6 +84,7 @@ class ArrayBufferStager(BufferStager):
         entry: Optional[TensorEntry] = None,
         array_prepare_func: Optional[Callable[[ArrayLike, bool], ArrayLike]] = None,
         dedup_entry: Optional[TensorEntry] = None,
+        record_dedup_hashes: bool = False,
     ) -> None:
         self.arr = arr
         self.is_async_snapshot = is_async_snapshot
@@ -96,6 +97,11 @@ class ArrayBufferStager(BufferStager):
         # root. If the staged bytes hash to the same checksums, the write
         # is skipped and ``entry`` adopts the previous blob's location.
         self.dedup_entry = dedup_entry
+        # Incremental takes record 64-bit per-tile dedup hashes so the
+        # NEXT increment can make tile-grain skip decisions with more
+        # than 32 bits of evidence (small tile-less blobs record theirs
+        # eagerly on every take — see _record_checksums).
+        self.record_dedup_hashes = record_dedup_hashes
         # User save-time transform (dtype cast / quantize-on-save),
         # applied to the ORIGINAL array at stage time with tracing=False
         # (reference io_preparers/tensor.py:231-241).
@@ -134,9 +140,21 @@ class ArrayBufferStager(BufferStager):
         if want_crc and self.dedup_entry is not None:
             # Incremental dedup: hash first (the expected outcome is
             # "unchanged", where no clone and no write happen at all).
+            # TILED blobs run the CRC-only pass here (whole-blob dedup
+            # on multiple independent tile CRCs needs no second hash)
+            # and pay the 64-bit tile-hash lane ONLY when they actually
+            # changed — an unchanged-state incremental take stays one
+            # hardware-CRC pass. Tile-less blobs need their dedup_hash
+            # as part of the match evidence, so they hash both up front
+            # (they are small or rare shapes).
             from ..io_types import SKIP_WRITE
 
-            _record_checksums(self.entry, mv)
+            tile_rows, _ = _tile_geometry(self.entry, mv.nbytes)
+            _record_checksums(
+                self.entry,
+                mv,
+                self.record_dedup_hashes and not tile_rows,
+            )
             if dedup_entries_match(self.entry, self.dedup_entry):
                 self.entry.location = self.dedup_entry.location
                 self.entry.byte_range = (
@@ -144,10 +162,41 @@ class ArrayBufferStager(BufferStager):
                     if self.dedup_entry.byte_range is not None
                     else None
                 )
+                # Same bytes as the base blob: its recorded 64-bit
+                # hashes describe this entry too — adopt them so the
+                # NEXT increment can still make tile-grain decisions.
+                if self.entry.tile_checksums and self.dedup_entry.tile_dedup_hashes:
+                    self.entry.tile_dedup_hashes = list(
+                        self.dedup_entry.tile_dedup_hashes
+                    )
+                if self.entry.dedup_hash is None:
+                    self.entry.dedup_hash = self.dedup_entry.dedup_hash
                 return SKIP_WRITE
-            if self.is_async_snapshot and _may_alias_live_memory(
+            clone = self.is_async_snapshot and _may_alias_live_memory(
                 self.arr, host
-            ):
+            )
+            if clone and self.record_dedup_hashes and tile_rows:
+                # Changed tiled blob on the async path: the defensive
+                # clone and the deferred tile-hash lane fuse into ONE
+                # memory pass (the CRCs recomputed alongside are the
+                # values already recorded).
+                from .. import _native
+
+                out = _native.aligned_empty(mv.nbytes)
+                _, row_nbytes = _tile_geometry(self.entry, mv.nbytes)
+                _, xxhs = _native.memcpy_crc_xxh_tiles(
+                    out, mv, tile_rows * row_nbytes
+                )
+                dalgo = _native.dedup_hash_algorithm()
+                self.entry.tile_dedup_hashes = [
+                    f"{dalgo}:{x & _XXH_MASK:016x}" for x in xxhs
+                ]
+                return out
+            if self.record_dedup_hashes and tile_rows:
+                # Changed tiled blob: record the tile-hash lane now (it
+                # is about to be written at disk speed anyway).
+                _record_tile_dedup_hashes(self.entry, mv)
+            if clone:
                 from .. import _native
 
                 out = _native.aligned_empty(mv.nbytes)
@@ -166,12 +215,32 @@ class ArrayBufferStager(BufferStager):
             out = _native.aligned_empty(mv.nbytes)
             if want_crc:
                 tile_rows, row_nbytes = _tile_geometry(self.entry, mv.nbytes)
+                want_dedup = _want_dedup_hashes(
+                    self.record_dedup_hashes, tile_rows, mv.nbytes
+                )
                 if tile_rows:
-                    crcs = _native.memcpy_crc_tiles(
-                        out, mv, tile_rows * row_nbytes
+                    if want_dedup:
+                        crcs, xxhs = _native.memcpy_crc_xxh_tiles(
+                            out, mv, tile_rows * row_nbytes
+                        )
+                    else:
+                        crcs = _native.memcpy_crc_tiles(
+                            out, mv, tile_rows * row_nbytes
+                        )
+                        xxhs = None
+                    _annotate_checksums(
+                        self.entry, crcs, tile_rows, row_nbytes, tile_xxhs=xxhs
+                    )
+                elif want_dedup:
+                    # Tile-less blob needing the 64-bit dedup hash: XXH64
+                    # has no combine, so the fused clone+hash runs as one
+                    # tile (single-threaded copy; tile-less dedup-hashed
+                    # blobs are small or rare (1, huge) shapes).
+                    crcs, xxhs = _native.memcpy_crc_xxh_tiles(
+                        out, mv, mv.nbytes
                     )
                     _annotate_checksums(
-                        self.entry, crcs, tile_rows, row_nbytes
+                        self.entry, crcs, 0, row_nbytes, whole_xxh=xxhs[0]
                     )
                 else:
                     # Whole-blob checksum: still clone in internal
@@ -191,7 +260,7 @@ class ArrayBufferStager(BufferStager):
                 _native.memcpy(out, mv)
             return out
         if want_crc:
-            _record_checksums(self.entry, mv)
+            _record_checksums(self.entry, mv, self.record_dedup_hashes)
         return mv
 
     def get_staging_cost_bytes(self) -> int:
@@ -205,9 +274,27 @@ class ArrayBufferStager(BufferStager):
 
 
 def _may_alias_live_memory(arr: ArrayLike, host: np.ndarray) -> bool:
+    """Whether the staged host buffer could alias memory the training
+    loop may overwrite (donation) — if so, an async snapshot must clone
+    it before returning control.
+
+    On NON-CPU backends (TPU/GPU) the answer is no: ``np.asarray`` of a
+    device array materializes a fresh host copy via DtoH — donation
+    reuses device HBM, never that host buffer — so async takes on real
+    accelerators skip the defensive clone entirely and their blocked
+    time is just DMA + hash. On CPU backends the "host copy" is a VIEW
+    of the XLA buffer, and host-resident (pinned_host, the UVM analog)
+    arrays alias host memory on any backend; numpy sources alias the
+    caller's array by construction — all of those clone."""
     if isinstance(arr, jax.Array):
-        return True  # conservatively assume the host view aliases XLA memory
-    # numpy source: the memoryview aliases the caller's array by construction
+        from ..host_offload import is_host_resident
+
+        if is_host_resident(arr):
+            return True
+        try:
+            return any(d.platform == "cpu" for d in arr.devices())
+        except Exception:
+            return True
     return True
 
 
@@ -248,8 +335,16 @@ def dedup_entries_match(new: TensorEntry, prev: TensorEntry) -> bool:
     byte-identical to the previous snapshot's blob per its recorded
     checksums — same dtype/shape/serializer, same whole-blob CRC, and the
     same tile-grain CRCs (a changed tile-size knob between takes makes
-    geometries differ and conservatively fails the match)."""
-    return (
+    geometries differ and conservatively fails the match).
+
+    Equality needs MORE than one 32-bit CRC (ADVICE r3: a changed blob
+    whose CRC collides with the base's silently restores stale data, a
+    ~2^-32 channel per blob-take at fleet scale): tiled blobs carry
+    multiple independent tile CRCs, and tile-less blobs must carry a
+    matching 64-bit ``dedup_hash`` on BOTH sides — a base without one
+    (older format, or a blob above the eager-hash size) conservatively
+    rewrites."""
+    if not (
         prev.checksum is not None
         and new.checksum == prev.checksum
         and new.dtype == prev.dtype
@@ -257,6 +352,18 @@ def dedup_entries_match(new: TensorEntry, prev: TensorEntry) -> bool:
         and new.serializer == prev.serializer
         and new.tile_rows == prev.tile_rows
         and new.tile_checksums == prev.tile_checksums
+    ):
+        return False
+    if new.tile_checksums:
+        # >= 2 independent 32-bit values already matched; the 64-bit tile
+        # hashes additionally bind when both sides recorded them.
+        if new.tile_dedup_hashes and prev.tile_dedup_hashes:
+            return new.tile_dedup_hashes == prev.tile_dedup_hashes
+        return True
+    return (
+        new.dedup_hash is not None
+        and prev.dedup_hash is not None
+        and new.dedup_hash == prev.dedup_hash
     )
 
 
@@ -299,15 +406,31 @@ def _tile_geometry(entry: TensorEntry, nbytes: int) -> Tuple[int, int]:
     return 0, row_nbytes
 
 
+# Tile-less blobs at or below this size record their 64-bit dedup hash
+# on EVERY take (cheap; lets the first increment against any base dedup
+# them). Larger tile-less blobs — rare (1, huge)-shaped arrays whose
+# hash pass is a real cost — record it only on incremental takes.
+_DEDUP_HASH_EAGER_MAX = 64 << 20
+
+
+def _want_dedup_hashes(record_flag: bool, tile_rows: int, nbytes: int) -> bool:
+    if tile_rows:
+        return record_flag
+    return record_flag or nbytes <= _DEDUP_HASH_EAGER_MAX
+
+
 def _annotate_checksums(
     entry: TensorEntry,
     tile_crcs: List[int],
     tile_rows: int,
     row_nbytes: int,
+    tile_xxhs: Optional[List[int]] = None,
+    whole_xxh: Optional[int] = None,
 ) -> None:
     """Record per-tile + combined whole-blob checksums into ``entry``
     from raw seed-0 CRC values (one per tile, or a single whole-blob
-    value when ``tile_rows`` is 0)."""
+    value when ``tile_rows`` is 0), plus the optional 64-bit dedup
+    hashes (per tile, or whole-blob)."""
     from .. import _native
 
     algo = _native.checksum_algorithm()
@@ -324,11 +447,40 @@ def _annotate_checksums(
             f"{algo}:{crc & 0xFFFFFFFF:08x}" for crc in tile_crcs
         ]
         entry.checksum = f"{algo}:{combined:08x}"
+        if tile_xxhs is not None:
+            dalgo = _native.dedup_hash_algorithm()
+            entry.tile_dedup_hashes = [
+                f"{dalgo}:{x & _XXH_MASK:016x}" for x in tile_xxhs
+            ]
     else:
         entry.checksum = f"{algo}:{tile_crcs[0] & 0xFFFFFFFF:08x}"
+        if whole_xxh is not None:
+            dalgo = _native.dedup_hash_algorithm()
+            entry.dedup_hash = f"{dalgo}:{whole_xxh & _XXH_MASK:016x}"
 
 
-def _record_checksums(entry: TensorEntry, mv: memoryview) -> None:
+_XXH_MASK = (1 << 64) - 1
+
+
+def _record_tile_dedup_hashes(entry: TensorEntry, mv: memoryview) -> None:
+    """Record ONLY the per-tile 64-bit dedup hashes (CRCs already
+    recorded) — the deferred lane for changed blobs in incremental
+    takes."""
+    from .. import _native
+
+    tile_rows, row_nbytes = _tile_geometry(entry, mv.nbytes)
+    if not tile_rows:
+        return
+    _, xxhs = _native.crc_xxh_tiles(mv, tile_rows * row_nbytes)
+    dalgo = _native.dedup_hash_algorithm()
+    entry.tile_dedup_hashes = [
+        f"{dalgo}:{x & _XXH_MASK:016x}" for x in xxhs
+    ]
+
+
+def _record_checksums(
+    entry: TensorEntry, mv: memoryview, record_dedup_hashes: bool = False
+) -> None:
     """Record integrity checksums into ``entry`` at stage time.
 
     Blobs large enough to be read under a memory budget are hashed in
@@ -336,21 +488,40 @@ def _record_checksums(entry: TensorEntry, mv: memoryview) -> None:
     derived by CRC combine — one hash pass either way. Budget-tiled
     reads align to these boundaries and verify by combining the covered
     tiles' values (beyond the reference, which has no end-to-end
-    integrity checking at all)."""
+    integrity checking at all).
+
+    ``record_dedup_hashes`` (incremental takes) additionally records the
+    64-bit XXH64 dedup hashes — per tile, fused into the same memory
+    pass — so the next increment's dedup decisions carry more than 32
+    bits of evidence per skipped unit. Small tile-less blobs record
+    theirs on every take (see _DEDUP_HASH_EAGER_MAX)."""
     from .. import _native
 
     tile_rows, row_nbytes = _tile_geometry(entry, mv.nbytes)
+    want_dedup = _want_dedup_hashes(record_dedup_hashes, tile_rows, mv.nbytes)
     if tile_rows:
         n_rows = entry.shape[0]
+        if want_dedup:
+            # Tile boundaries are uniform except the last; the fused
+            # native pass tiles by byte count, which matches exactly.
+            crcs, xxhs = _native.crc_xxh_tiles(mv, tile_rows * row_nbytes)
+            _annotate_checksums(
+                entry, crcs, tile_rows, row_nbytes, tile_xxhs=xxhs
+            )
+            return
         crcs = [
             _native.crc32c(
                 mv[r0 * row_nbytes : min(r0 + tile_rows, n_rows) * row_nbytes]
             )
             for r0 in range(0, n_rows, tile_rows)
         ]
-    else:
-        crcs = [_native.crc32c(mv)]
-    _annotate_checksums(entry, crcs, tile_rows, row_nbytes)
+        _annotate_checksums(entry, crcs, tile_rows, row_nbytes)
+        return
+    if want_dedup:
+        crcs, xxhs = _native.crc_xxh_tiles(mv, mv.nbytes)
+        _annotate_checksums(entry, crcs, 0, row_nbytes, whole_xxh=xxhs[0])
+        return
+    _annotate_checksums(entry, [_native.crc32c(mv)], 0, row_nbytes)
 
 
 def combined_tile_checksum(
@@ -561,6 +732,7 @@ class ArrayIOPreparer:
         array_prepare_func: Optional[Callable[[ArrayLike, bool], ArrayLike]] = None,
         array_prepare_traced: Optional[Tuple[str, List[int]]] = None,
         prev_entry: Optional[object] = None,
+        record_dedup_hashes: bool = False,
     ) -> Tuple[TensorEntry, List[WriteReq]]:
         if array_prepare_traced is not None:
             dtype, shape = array_prepare_traced[0], list(array_prepare_traced[1])
@@ -586,6 +758,7 @@ class ArrayIOPreparer:
                         if isinstance(prev_entry, TensorEntry)
                         else None
                     ),
+                    record_dedup_hashes=record_dedup_hashes,
                 ),
             )
         ]

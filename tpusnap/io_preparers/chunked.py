@@ -57,7 +57,15 @@ class ChunkedArrayIOPreparer:
         array_prepare_func=None,
         array_prepare_traced=None,
         prev_entry=None,
+        record_dedup_hashes: bool = False,
+        chunk_rows: Optional[int] = None,
+        prev_chunks: Optional[dict] = None,
     ) -> Tuple[ChunkedTensorEntry, List[WriteReq]]:
+        """``chunk_rows``/``prev_chunks`` are set by the tile-grain
+        incremental route (io_preparer.prepare_write): chunks follow the
+        previous snapshot's checksum-tile grid instead of the chunk-size
+        knob, and each chunk dedups against the synthesized per-tile
+        entry for its row range — so only changed tiles are written."""
         from .array import trace_array_prepare
 
         # Chunk geometry follows the TRANSFORMED dtype (a cast-on-save
@@ -70,13 +78,21 @@ class ChunkedArrayIOPreparer:
         # Incremental dedup: match chunks of the previous snapshot's entry
         # by (offsets, sizes) — a changed chunk-size knob between takes
         # shifts boundaries and conservatively misses.
-        prev_chunks = {}
-        if isinstance(prev_entry, ChunkedTensorEntry):
-            prev_chunks = {
-                (tuple(c.offsets), tuple(c.sizes)): c.tensor
-                for c in prev_entry.chunks
-            }
-        ranges = chunk_row_ranges(shape, dtype, get_max_chunk_size_bytes())
+        if prev_chunks is None:
+            prev_chunks = {}
+            if isinstance(prev_entry, ChunkedTensorEntry):
+                prev_chunks = {
+                    (tuple(c.offsets), tuple(c.sizes)): c.tensor
+                    for c in prev_entry.chunks
+                }
+        if chunk_rows is not None:
+            n_rows = shape[0]
+            ranges = [
+                (r0, min(r0 + chunk_rows, n_rows))
+                for r0 in range(0, n_rows, chunk_rows)
+            ]
+        else:
+            ranges = chunk_row_ranges(shape, dtype, get_max_chunk_size_bytes())
         chunks: List[Chunk] = []
         write_reqs: List[WriteReq] = []
         ndim = len(shape)
@@ -107,6 +123,7 @@ class ChunkedArrayIOPreparer:
                         dedup_entry=prev_chunks.get(
                             (tuple(offsets), tuple(sizes))
                         ),
+                        record_dedup_hashes=record_dedup_hashes,
                     ),
                 )
             )
@@ -182,6 +199,92 @@ class ChunkedArrayIOPreparer:
                 )
             )
         return read_reqs, fut
+
+
+def tile_prev_map(
+    prev_entry, dtype: str, shape: List[int]
+) -> Optional[Tuple[int, dict]]:
+    """Per-tile view of a previous snapshot's entry for tile-grain
+    incremental dedup: ``(grid_rows, {(offsets, sizes): TensorEntry})``
+    with one synthesized entry per checksum tile — its byte range within
+    the previous blob, its recorded tile CRC, and its 64-bit tile dedup
+    hash — or None when tile-grain dedup is not possible (mismatched
+    identity, no tile checksums, no dedup hashes, or an irregular grid).
+
+    Accepts a dense ``TensorEntry`` carrying ``tile_checksums`` +
+    ``tile_dedup_hashes``, or a ``ChunkedTensorEntry`` produced by a
+    previous tile-grain take (uniform tile-sized chunks, each carrying
+    its own checksum + dedup_hash) — so incremental chains keep
+    dedup'ing tile-grain after the first increment changes the entry's
+    geometry. Every skip decision this map backs compares BOTH a 32-bit
+    CRC and a 64-bit hash per tile (see dedup_entries_match)."""
+    serializer = Serializer.BUFFER_PROTOCOL.value
+    if (
+        isinstance(prev_entry, TensorEntry)
+        and prev_entry.serializer == serializer
+        and prev_entry.dtype == dtype
+        and list(prev_entry.shape) == list(shape)
+        and prev_entry.tile_rows
+        and prev_entry.tile_checksums
+        and prev_entry.tile_dedup_hashes
+        and len(prev_entry.tile_checksums) == len(prev_entry.tile_dedup_hashes)
+    ):
+        t = prev_entry.tile_rows
+        n_rows = shape[0]
+        row_nbytes = tensor_nbytes(dtype, shape[1:]) if len(shape) > 1 else tensor_nbytes(dtype, [1])
+        base = prev_entry.byte_range[0] if prev_entry.byte_range else 0
+        ndim = len(shape)
+        out = {}
+        for i, (crc, dh) in enumerate(
+            zip(prev_entry.tile_checksums, prev_entry.tile_dedup_hashes)
+        ):
+            r0, r1 = i * t, min((i + 1) * t, n_rows)
+            offsets = tuple([r0] + [0] * (ndim - 1))
+            sizes = tuple([r1 - r0] + list(shape[1:]))
+            out[(offsets, sizes)] = TensorEntry(
+                location=prev_entry.location,
+                serializer=serializer,
+                dtype=dtype,
+                shape=list(sizes),
+                replicated=False,
+                byte_range=[base + r0 * row_nbytes, base + r1 * row_nbytes],
+                checksum=crc,
+                dedup_hash=dh,
+            )
+        return t, out
+    if (
+        isinstance(prev_entry, ChunkedTensorEntry)
+        and prev_entry.dtype == dtype
+        and list(prev_entry.shape) == list(shape)
+        and prev_entry.chunks
+    ):
+        chunks = sorted(prev_entry.chunks, key=lambda c: c.offsets[0])
+        t = chunks[0].sizes[0]
+        n_rows = shape[0]
+        out = {}
+        expect_r0 = 0
+        for i, c in enumerate(chunks):
+            r0 = c.offsets[0]
+            r1 = r0 + c.sizes[0]
+            last = i == len(chunks) - 1
+            if (
+                r0 != expect_r0
+                or (not last and c.sizes[0] != t)
+                or (last and r1 != n_rows)
+                or any(o != 0 for o in c.offsets[1:])
+                or list(c.sizes[1:]) != list(shape[1:])
+                or c.tensor.serializer != serializer
+                or c.tensor.checksum is None
+                or c.tensor.dedup_hash is None
+                or c.tensor.tile_rows  # oversized chunk: grid not tile-sized
+            ):
+                return None
+            out[(tuple(c.offsets), tuple(c.sizes))] = c.tensor
+            expect_r0 = r1
+        if expect_r0 != n_rows or len(out) < 2:
+            return None
+        return t, out
+    return None
 
 
 def _chunk_as_full_entry(entry: ChunkedTensorEntry, chunk: Chunk) -> TensorEntry:

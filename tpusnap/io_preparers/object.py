@@ -81,10 +81,14 @@ class ObjectIOPreparer:
         from ..knobs import is_checksum_disabled
 
         checksum = None
+        dedup_hash = None
         if not is_checksum_disabled():
             from .. import _native
 
             checksum = _native.checksum_string(buf)
+            # Objects are small; always carry the 64-bit dedup hash so
+            # dedup never rests on a single 32-bit CRC (ADVICE r3).
+            dedup_hash = _native.dedup_hash_string(buf)
         entry = ObjectEntry(
             location=storage_path,
             serializer=Serializer.PICKLE.value,
@@ -92,13 +96,19 @@ class ObjectIOPreparer:
             replicated=replicated,
             nbytes=len(buf),
             checksum=checksum,
+            dedup_hash=dedup_hash,
         )
         # Incremental dedup: objects pickle + hash eagerly at prepare
         # time, so an unchanged object needs no write request at all.
+        # Requires the 96 bits of combined evidence on both sides; a
+        # base written before dedup hashes existed conservatively
+        # rewrites.
         if (
             isinstance(prev_entry, ObjectEntry)
             and checksum is not None
             and prev_entry.checksum == checksum
+            and dedup_hash is not None
+            and prev_entry.dedup_hash == dedup_hash
             and prev_entry.nbytes == len(buf)
             and prev_entry.serializer == entry.serializer
         ):

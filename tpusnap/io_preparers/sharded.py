@@ -119,6 +119,7 @@ class ShardedArrayIOPreparer:
         array_prepare_func=None,
         array_prepare_traced: Optional[Tuple[str, List[int]]] = None,
         prev_entry=None,
+        record_dedup_hashes: bool = False,
     ) -> Tuple[ShardedEntry, List[WriteReq]]:
         """``array_prepare_func(arr, tracing)`` is the user save-time
         transform, applied PER LOCAL SHARD at stage time (the reference
@@ -184,6 +185,7 @@ class ShardedArrayIOPreparer:
                             dedup_entry=prev_shards.get(
                                 (tuple(sub_off), tuple(sub_sz))
                             ),
+                            record_dedup_hashes=record_dedup_hashes,
                         ),
                     )
                 )

@@ -27,6 +27,8 @@ _DIRECT_IO_QD_ENV_VAR = "TPUSNAP_DIRECT_IO_QD"
 _DIRECT_IO_CHUNK_ENV_VAR = "TPUSNAP_DIRECT_IO_CHUNK_BYTES"
 _TILE_CHECKSUM_ENV_VAR = "TPUSNAP_TILE_CHECKSUM_BYTES"
 _SCRUB_CONCURRENCY_ENV_VAR = "TPUSNAP_SCRUB_CONCURRENCY"
+_RECORD_DEDUP_HASHES_ENV_VAR = "TPUSNAP_RECORD_DEDUP_HASHES"
+_DURABLE_COMMIT_ENV_VAR = "TPUSNAP_DURABLE_COMMIT"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -124,6 +126,33 @@ def get_scrub_concurrency() -> int:
     return max(1, _get_int_env(_SCRUB_CONCURRENCY_ENV_VAR, 4))
 
 
+def is_durable_commit_enabled() -> bool:
+    """Make a returned take survive power loss: every blob file is
+    fsync'd after its write, and the metadata commit fsyncs its temp
+    file, renames, then fsyncs every directory the snapshot created —
+    data, dirents and the commit record all on stable storage, in that
+    order. Off by default: the fsyncs after a multi-GB take force the
+    device to flush everything just written (~2 s measured on the dev
+    host's virtio disk), a cost the baselines tpusnap is benchmarked
+    against (torch.save, the reference) never pay. Without it the
+    commit is still crash-SAFE (temp+rename: never torn, at worst
+    invisible/incomplete-and-invisible); metadata REWRITES of committed
+    snapshots (materialize, retention) fsync their own commit
+    unconditionally — there the flush is cheap and the downside is
+    destroying good state."""
+    return os.environ.get(_DURABLE_COMMIT_ENV_VAR, "0") == "1"
+
+
+def is_dedup_hash_recording_forced() -> bool:
+    """Record 64-bit per-tile dedup hashes on EVERY take, not just
+    incremental ones — set on the FULL base take of a planned
+    incremental chain so the first increment can already make
+    tile-grain skip decisions against it (otherwise the chain reaches
+    tile grain from the second increment on). Costs one extra fused
+    hash lane (~2x the hash pass) on large tiled blobs."""
+    return os.environ.get(_RECORD_DEDUP_HASHES_ENV_VAR, "0") == "1"
+
+
 def get_memory_budget_override_bytes() -> Optional[int]:
     if _MEMORY_BUDGET_ENV_VAR not in os.environ:
         return None
@@ -198,4 +227,10 @@ def override_checksum_disabled(disabled: bool) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_tile_checksum_bytes(nbytes: int) -> Generator[None, None, None]:
     with _override_env(_TILE_CHECKSUM_ENV_VAR, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_record_dedup_hashes(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(_RECORD_DEDUP_HASHES_ENV_VAR, "1" if enabled else "0"):
         yield

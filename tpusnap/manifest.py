@@ -66,6 +66,16 @@ class TensorEntry(Entry):
     # budget path detects corruption too, at no extra hash pass anywhere.
     tile_rows: Optional[int] = None
     tile_checksums: Optional[List[str]] = None
+    # Second, independent hash backing incremental-dedup equality
+    # ("<algo>:<16-hex>", algo xxh64 native / sha256-64 fallback). A
+    # single 32-bit CRC leaves a ~2^-32 silent-collision channel per
+    # blob-take; dedup of a tile-LESS blob requires BOTH the CRC and
+    # this value to match. Tiled blobs dedup whole on their multiple
+    # independent tile CRCs; ``tile_dedup_hashes`` (recorded on
+    # incremental takes) additionally gives each TILE a 64-bit value so
+    # tile-grain dedup decisions are equally strong.
+    dedup_hash: Optional[str] = None
+    tile_dedup_hashes: Optional[List[str]] = None
 
     def __init__(
         self,
@@ -78,6 +88,8 @@ class TensorEntry(Entry):
         checksum: Optional[str] = None,
         tile_rows: Optional[int] = None,
         tile_checksums: Optional[Sequence[str]] = None,
+        dedup_hash: Optional[str] = None,
+        tile_dedup_hashes: Optional[Sequence[str]] = None,
     ) -> None:
         super().__init__(type="Tensor")
         self.location = location
@@ -90,6 +102,10 @@ class TensorEntry(Entry):
         self.tile_rows = tile_rows
         self.tile_checksums = (
             list(tile_checksums) if tile_checksums is not None else None
+        )
+        self.dedup_hash = dedup_hash
+        self.tile_dedup_hashes = (
+            list(tile_dedup_hashes) if tile_dedup_hashes is not None else None
         )
 
     @classmethod
@@ -104,6 +120,8 @@ class TensorEntry(Entry):
             checksum=d.get("checksum"),
             tile_rows=d.get("tile_rows"),
             tile_checksums=d.get("tile_checksums"),
+            dedup_hash=d.get("dedup_hash"),
+            tile_dedup_hashes=d.get("tile_dedup_hashes"),
         )
 
 
@@ -201,6 +219,7 @@ class ObjectEntry(Entry):
     replicated: bool
     nbytes: Optional[int] = None  # serialized size; drives read memory budget
     checksum: Optional[str] = None  # "<algo>:<8-hex>" (see TensorEntry)
+    dedup_hash: Optional[str] = None  # "<algo>:<16-hex>" (see TensorEntry)
 
     def __init__(
         self,
@@ -210,6 +229,7 @@ class ObjectEntry(Entry):
         replicated: bool,
         nbytes: Optional[int] = None,
         checksum: Optional[str] = None,
+        dedup_hash: Optional[str] = None,
     ) -> None:
         super().__init__(type="object")
         self.location = location
@@ -218,6 +238,7 @@ class ObjectEntry(Entry):
         self.replicated = replicated
         self.nbytes = nbytes
         self.checksum = checksum
+        self.dedup_hash = dedup_hash
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ObjectEntry":
@@ -228,6 +249,7 @@ class ObjectEntry(Entry):
             replicated=d["replicated"],
             nbytes=d.get("nbytes"),
             checksum=d.get("checksum"),
+            dedup_hash=d.get("dedup_hash"),
         )
 
 
@@ -424,6 +446,15 @@ class SnapshotMetadata:
     # ordering by mtime could delete the newest checkpoints). Optional:
     # absent in pre-field snapshots.
     created_at: Optional[float] = None
+    # Base-snapshot roots (relative, "../"-prefixed) this incremental
+    # snapshot's external blob locations point into — recorded at take
+    # time so retention/info/materialize never have to GUESS where a
+    # base root ends inside a location string (a base path containing a
+    # purely numeric directory, e.g. "../exp/1000/final/0/w", is
+    # ambiguous to grammar parsing — ADVICE r3). Absent/empty for
+    # self-contained snapshots and pre-field increments (readers fall
+    # back to parsing).
+    base_roots: Optional[List[str]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -432,6 +463,8 @@ class SnapshotMetadata:
         }
         if self.created_at is not None:
             d["created_at"] = self.created_at
+        if self.base_roots:
+            d["base_roots"] = list(self.base_roots)
         d["manifest"] = {
             k: _entry_to_dict(v) for k, v in self.manifest.items()
         }
@@ -450,6 +483,7 @@ class SnapshotMetadata:
             world_size=d["world_size"],
             manifest=manifest,
             created_at=d.get("created_at"),
+            base_roots=d.get("base_roots"),
         )
 
     @classmethod

@@ -192,9 +192,20 @@ class Snapshot:
         like any snapshot — but it REQUIRES the base snapshot(s) to stay
         alive; deleting a base breaks the snapshots layered on it
         (``python -m tpusnap verify`` reports the dangling references).
-        Slab-batched small arrays always rewrite; blobs above the slab
-        threshold, all shards, and large chunks dedup. Pass the same
-        value on every rank.
+        Dedup is fine-grained: slab-batched small arrays dedup per
+        member (the new slab holds only changed members), and a large
+        array whose base entry carries per-tile dedup hashes rewrites
+        only its CHANGED checksum tiles — one changed row of a multi-GB
+        array costs one tile, with unchanged tiles stored as byte-range
+        references into the base blob. Every skip decision requires a
+        32-bit CRC AND an independent 64-bit hash to match. Tile-grain
+        skips need the PREVIOUS entry to carry per-tile dedup hashes,
+        which incremental takes record whenever they WRITE a blob — so
+        in a chain, each blob reaches tile grain one take after it
+        first rewrites (its unchanged takes skip whole-blob on the
+        CRC-only pass). Set TPUSNAP_RECORD_DEDUP_HASHES=1 on the full
+        base take to give every blob tile grain from the first
+        increment. Pass the same value on every rank.
 
         ``per_key_barrier=True`` restores the reference's barrier
         between every stateful's ``state_dict()`` call (snapshot.py:
@@ -218,6 +229,13 @@ class Snapshot:
                 incremental_from=incremental_from,
             )
             pending_io_work.sync_complete(event_loop)
+            from .knobs import is_durable_commit_enabled
+
+            if is_durable_commit_enabled():
+                # Every rank makes its own dirents durable before the
+                # commit barrier — rank 0's metadata fsync can only
+                # cover directories ITS plugin instance created.
+                storage.sync_flush_created_dirs(event_loop)
             comm.barrier()
             if comm.rank == 0:
                 _write_metadata(storage, metadata, event_loop)
@@ -299,9 +317,28 @@ class Snapshot:
         hostname gather) is taken HERE, on the calling thread, before
         the thread starts. ``per_key_barrier`` restores are inherently
         collective and have no async form (beyond the reference, which
-        has no async restore either)."""
+        has no async restore either) — a stateful whose
+        ``load_state_dict`` runs device collectives must declare
+        ``load_requires_collectives = True`` (see ``Stateful``) and is
+        REJECTED here: running its collectives from this background
+        thread, unordered against other ranks, deadlocks or corrupts
+        (the reference bans collectives off-thread the same way,
+        snapshot.py:902)."""
         comm = get_communicator(self._comm)
         _validate_app_state(app_state)
+        offenders = sorted(
+            key
+            for key, stateful in app_state.items()
+            if getattr(stateful, "load_requires_collectives", False)
+        )
+        if offenders:
+            raise ValueError(
+                f"async_restore cannot restore {offenders}: their "
+                "load_state_dict declares load_requires_collectives=True, "
+                "and collectives must not run on the background restore "
+                "thread (unordered across ranks -> deadlock/corruption). "
+                "Use restore(per_key_barrier=True) for these statefuls."
+            )
         # Cold-start collective on the calling thread; cached afterwards.
         memory_budget = get_process_memory_budget_bytes(comm)
         return PendingRestore(self, app_state, comm, memory_budget)
@@ -633,13 +670,20 @@ def _take_impl(
                 "incremental_from requires checksums; unset "
                 "TPUSNAP_DISABLE_CHECKSUM to take an incremental snapshot"
             )
-        prev_entries = _load_prev_entries(
+        prev_entries, base_root_candidates = _load_prev_entries(
             incremental_from, storage_options, rank, path, event_loop
         )
+    else:
+        base_root_candidates = []
 
     entries: Manifest = dict(manifest)
     write_reqs = []
     replicated_entry_paths: List[str] = []
+    from .knobs import is_dedup_hash_recording_forced
+
+    record_dedup_hashes = (
+        incremental_from is not None or is_dedup_hash_recording_forced()
+    )
     for logical_path, leaf in flattened_all.items():
         is_repl = logical_path in replicated_paths
         entry, reqs = prepare_write(
@@ -655,6 +699,12 @@ def _take_impl(
             ),
             array_prepare_traced=traced_geometry.get(logical_path),
             prev_entry=prev_entries.get(logical_path),
+            record_dedup_hashes=record_dedup_hashes,
+            # Multi-process replicated entries keep blob-grain geometry:
+            # the write-load estimator's unit ids (computed on every
+            # rank without prev-entry knowledge) must match what was
+            # prepared.
+            allow_tile_dedup=not (multi and is_repl),
         )
         entries[logical_path] = entry
         if is_repl and is_replicated(entry):
@@ -698,8 +748,41 @@ def _take_impl(
         world_size=comm.world_size,
         manifest=global_manifest,
         created_at=time.time(),
+        # Record which base roots the external references point into:
+        # retention/info/materialize then never parse roots out of
+        # location strings (ambiguous when a base path contains a
+        # numeric directory). Computed from the gathered manifest, so
+        # identical on the rank that commits.
+        base_roots=_referenced_base_roots(
+            global_manifest, base_root_candidates
+        )
+        or None,
     )
     return pending_io_work, metadata, path, storage
+
+
+def _referenced_base_roots(
+    manifest: Manifest, candidates: List[str]
+) -> List[str]:
+    """The subset of candidate base roots actually referenced by the
+    manifest's external (``../``) blob locations — matched with the
+    SAME longest-prefix rule readers use (``base_root_of_location``),
+    so what the writer records is byte-identical to what a reader
+    resolves."""
+    if not candidates:
+        return []
+    from .inspect import base_root_of_location
+
+    roots = set()
+    for entry in manifest.values():
+        for t in _prev_entry_tensors(entry):
+            loc = t.location
+            if not loc.startswith("../"):
+                continue
+            matched = base_root_of_location(loc, known_roots=candidates)
+            if matched in candidates:
+                roots.add(matched)
+    return sorted(roots)
 
 
 def _relative_ref_prefix(base_path: str, new_path: str) -> str:
@@ -764,11 +847,17 @@ def _load_prev_entries(
     rank: int,
     new_path: str,
     event_loop: asyncio.AbstractEventLoop,
-) -> Manifest:
+):
     """This rank's manifest view of the base snapshot (replicated
     re-expansion + sharded merge, like restore uses), with every blob
     location rewritten relative to the new snapshot root — ready to hand
-    to ``prepare_write`` as dedup candidates."""
+    to ``prepare_write`` as dedup candidates. Returns
+    ``(entries, base_root_candidates)``: the candidates are every base
+    root a rewritten location can point into — the base itself plus the
+    base's own recorded roots (chained references collapse through
+    them), re-expressed relative to the new snapshot."""
+    import posixpath
+
     rel_prefix = _relative_ref_prefix(incremental_from, new_path)
     storage = url_to_storage_plugin_in_event_loop(
         incremental_from, event_loop, storage_options
@@ -811,9 +900,14 @@ def _load_prev_entries(
             "or by a different build?) — dedup is impossible, every blob "
             "would silently rewrite in full"
         )
-    return {
-        p: _rewrite_entry_locations(e, rel_prefix) for p, e in view.items()
-    }
+    candidates = [rel_prefix] + [
+        posixpath.normpath(posixpath.join(rel_prefix, r))
+        for r in (prev_md.base_roots or [])
+    ]
+    return (
+        {p: _rewrite_entry_locations(e, rel_prefix) for p, e in view.items()},
+        candidates,
+    )
 
 
 def _prev_entry_tensors(entry: Entry):
@@ -855,12 +949,18 @@ def _write_metadata(
 ) -> None:
     # Atomic (temp+rename on fs): a crash mid-write must not leave a
     # torn metadata file — it would be indistinguishable from corruption.
+    # Durability (power-loss survival of the commit) is knob-opted: the
+    # fsync after a multi-GB take flushes the storage cache of the whole
+    # take (see knobs.is_durable_commit_enabled).
+    from .knobs import is_durable_commit_enabled
+
     storage.sync_write_atomic(
         WriteIO(
             path=SNAPSHOT_METADATA_FNAME,
             buf=metadata.to_yaml().encode("utf-8"),
         ),
         event_loop,
+        durable=is_durable_commit_enabled(),
     )
 
 
@@ -1083,6 +1183,12 @@ class PendingSnapshot(_BackgroundWork):
 
     def _body(self) -> None:
         self._pending_io_work.sync_complete(self._event_loop)
+        from .knobs import is_durable_commit_enabled
+
+        if is_durable_commit_enabled():
+            # Per-rank dirent durability before the commit barrier (see
+            # the sync take's identical step).
+            self._storage.sync_flush_created_dirs(self._event_loop)
         self._barrier.arrive()
         if self._comm.rank == 0:
             _write_metadata(self._storage, self._metadata, self._event_loop)
