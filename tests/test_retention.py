@@ -117,3 +117,210 @@ def test_cli_retain(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "deleted" in out
     assert sorted(os.listdir(root)) == ["s2"]
+
+
+# --------------------------------------------------------------- round 4:
+# crash/fault injection on the materialize-then-delete sequence — the
+# module's headline claim ("a crash at any point leaves every kept
+# snapshot readable") exercised, not just asserted.
+
+
+def _restore_ok(path, frozen, hots, i):
+    tgt = {"app": StateDict(frozen=np.zeros_like(frozen),
+                            hot=np.zeros(64, np.float32), step=-1)}
+    Snapshot(path).restore(tgt)
+    assert tgt["app"]["step"] == i
+    assert np.array_equal(tgt["app"]["frozen"], frozen)
+    assert np.array_equal(tgt["app"]["hot"], hots[i])
+
+
+class TestCrashMidLifecycle:
+    def _multi_blob_chain(self, tmp_path):
+        """A chain whose final increment references SEVERAL base blobs,
+        so a fault can land between copies."""
+        root = str(tmp_path)
+        rng = np.random.default_rng(0)
+        frozen = {
+            f"f{i}": rng.standard_normal((256, 64)).astype(np.float32)
+            for i in range(4)
+        }
+        prev = None
+        hots = []
+        with override_batching_disabled(True):
+            for i in range(3):
+                hot = np.full((64,), float(i), np.float32)
+                hots.append(hot)
+                path = os.path.join(root, f"s{i}")
+                Snapshot.take(
+                    path,
+                    {"app": StateDict(hot=hot, step=i, **frozen)},
+                    incremental_from=prev,
+                )
+                prev = path
+        return root, frozen, hots
+
+    def _restore_multi_ok(self, path, frozen, hots, i):
+        tgt = {"app": StateDict(
+            hot=np.zeros(64, np.float32), step=-1,
+            **{k: np.zeros_like(v) for k, v in frozen.items()},
+        )}
+        Snapshot(path).restore(tgt)
+        assert tgt["app"]["step"] == i
+        assert np.array_equal(tgt["app"]["hot"], hots[i])
+        for k, v in frozen.items():
+            assert np.array_equal(tgt["app"][k], v), k
+
+    def test_fault_mid_materialize_keeps_snapshot_readable(
+        self, tmp_path, monkeypatch
+    ):
+        """Blob-copy writes fail partway through materialize: the
+        manifest must NOT have been rewritten (metadata commit is last,
+        atomic), the increment stays base-referencing and readable, and
+        a re-run converges."""
+        from tpusnap.storage_plugins.fs import FSStoragePlugin
+
+        root, frozen, hots = self._multi_blob_chain(tmp_path)
+        s2 = os.path.join(root, "s2")
+        calls = {"n": 0}
+        real_write = FSStoragePlugin.write
+
+        async def faulty_write(self, write_io):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise IOError("injected: storage died mid-materialize")
+            return await real_write(self, write_io)
+
+        monkeypatch.setattr(FSStoragePlugin, "write", faulty_write)
+        from tpusnap.inspect import materialize_snapshot
+
+        with pytest.raises(Exception, match="injected"):
+            materialize_snapshot(s2)
+        monkeypatch.setattr(FSStoragePlugin, "write", real_write)
+        assert calls["n"] >= 2  # the fault landed between copies
+        # Still an increment (metadata untouched), still fully readable.
+        md = Snapshot(s2).metadata
+        assert md.base_roots  # still references its bases
+        self._restore_multi_ok(s2, frozen, hots, 2)
+        assert verify_snapshot(s2).clean
+        # Re-run converges to self-contained.
+        stats = materialize_snapshot(s2)
+        assert stats["blobs_copied"] >= 1
+        assert Snapshot(s2).metadata.base_roots is None
+        self._restore_multi_ok(s2, frozen, hots, 2)
+        assert verify_snapshot(s2).clean
+
+    def test_fault_mid_metadata_commit_keeps_snapshot_readable(
+        self, tmp_path, monkeypatch
+    ):
+        """The atomic metadata rewrite itself fails: the OLD metadata
+        must survive intact (temp+rename discipline) and a re-run
+        converges."""
+        from tpusnap.storage_plugins.fs import FSStoragePlugin
+
+        root, frozen, hots = _chain(tmp_path)
+        s2 = os.path.join(root, "s2")
+
+        async def faulty_atomic(self, write_io, durable=False):
+            raise IOError("injected: died in metadata commit")
+
+        monkeypatch.setattr(FSStoragePlugin, "write_atomic", faulty_atomic)
+        from tpusnap.inspect import materialize_snapshot
+
+        with pytest.raises(Exception, match="injected"):
+            materialize_snapshot(s2)
+        monkeypatch.undo()
+        _restore_ok(s2, frozen, hots, 2)
+        assert verify_snapshot(s2).clean
+        materialize_snapshot(s2)
+        _restore_ok(s2, frozen, hots, 2)
+
+    def test_crash_mid_delete_keeps_survivors_readable(
+        self, tmp_path, monkeypatch
+    ):
+        """The delete phase dies halfway (first rmtree succeeds, second
+        raises — the moral equivalent of a kill between unlinks): every
+        KEPT snapshot is already self-contained and readable, and a
+        re-run of retention converges."""
+        import shutil
+
+        root, frozen, hots = _chain(tmp_path)
+        real_rmtree = shutil.rmtree
+        calls = {"n": 0}
+
+        def faulty_rmtree(path, *a, **kw):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise OSError("injected: crash mid-delete")
+            return real_rmtree(path, *a, **kw)
+
+        monkeypatch.setattr("tpusnap.retention.shutil.rmtree", faulty_rmtree)
+        with pytest.raises(OSError, match="injected"):
+            apply_retention(root, keep_last=1)
+        monkeypatch.undo()
+        # The kept snapshot was materialized BEFORE any deletion: it is
+        # readable even though its bases are half-gone.
+        s2 = os.path.join(root, "s2")
+        _restore_ok(s2, frozen, hots, 2)
+        assert verify_snapshot(s2).clean
+        # Re-run converges (idempotent on the debris).
+        plan = apply_retention(root, keep_last=1)
+        assert plan.executed
+        assert sorted(os.listdir(root)) == ["s2"]
+        _restore_ok(s2, frozen, hots, 2)
+
+    def test_sigkill_mid_materialize(self, tmp_path):
+        """The hard version of the claim: SIGKILL (no cleanup, no
+        exception handling) mid-materialize leaves the increment
+        readable and a fresh-process re-run converges."""
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        root, frozen, hots = _chain(tmp_path)
+        s2 = os.path.join(root, "s2")
+        child_src = (
+            "import os, sys, time\n"
+            "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            "import tpusnap.storage_plugins.fs as fsmod\n"
+            "real = fsmod.FSStoragePlugin.write\n"
+            "async def slow(self, wio):\n"
+            "    await real(self, wio)\n"
+            "    print('COPIED', flush=True)\n"
+            "    time.sleep(30)\n"  # hold mid-copy so the kill lands here
+            "fsmod.FSStoragePlugin.write = slow\n"
+            "from tpusnap.inspect import materialize_snapshot\n"
+            "print('READY', flush=True)\n"
+            "materialize_snapshot(sys.argv[1])\n"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", child_src, s2],
+            stdout=subprocess.PIPE,
+            text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        try:
+            deadline = time.monotonic() + 120
+            saw_copy = False
+            for line in proc.stdout:
+                if "COPIED" in line:
+                    saw_copy = True
+                    break
+                if time.monotonic() > deadline:
+                    break
+            assert saw_copy, "child never copied a blob"
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        # Metadata untouched -> still an increment, still readable.
+        assert Snapshot(s2).metadata.base_roots
+        _restore_ok(s2, frozen, hots, 2)
+        assert verify_snapshot(s2).clean
+        from tpusnap.inspect import materialize_snapshot
+
+        stats = materialize_snapshot(s2)
+        assert stats["blobs_copied"] >= 1
+        assert Snapshot(s2).metadata.base_roots is None
+        _restore_ok(s2, frozen, hots, 2)

@@ -400,3 +400,47 @@ class TestAbortPath:
         # The loop is clean: a fresh coroutine runs unobstructed.
         assert loop.run_until_complete(asyncio.sleep(0, result=42)) == 42
         loop.close()
+
+
+def test_write_atomic_durable_flag(tmp_path):
+    """durable=True fsyncs (file + parent dir) and still lands the same
+    bytes; the take commit honors TPUSNAP_DURABLE_COMMIT."""
+    import asyncio
+    import os
+
+    from tpusnap.io_types import WriteIO
+    from tpusnap.storage_plugins.fs import FSStoragePlugin
+
+    loop = asyncio.new_event_loop()
+    plugin = FSStoragePlugin(str(tmp_path))
+    fsyncs = []
+    real_fsync = os.fsync
+    try:
+        plugin.sync_write_atomic(
+            WriteIO(path="meta", buf=b"payload-1"), loop, durable=False
+        )
+        assert (tmp_path / "meta").read_bytes() == b"payload-1"
+        import unittest.mock as mock
+
+        with mock.patch("os.fsync", side_effect=lambda fd: (fsyncs.append(fd), real_fsync(fd))):
+            plugin.sync_write_atomic(
+                WriteIO(path="meta", buf=b"payload-2"), loop, durable=True
+            )
+        assert (tmp_path / "meta").read_bytes() == b"payload-2"
+        assert len(fsyncs) == 2  # temp file + parent directory
+    finally:
+        plugin.sync_close(loop)
+        loop.close()
+
+
+def test_durable_commit_knob_round_trip(tmp_path, monkeypatch):
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict
+
+    monkeypatch.setenv("TPUSNAP_DURABLE_COMMIT", "1")
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"app": StateDict(w=np.arange(32, dtype=np.float32))})
+    target = {"app": StateDict(w=np.zeros(32, np.float32))}
+    Snapshot(path).restore(target)
+    assert np.array_equal(target["app"]["w"], np.arange(32, dtype=np.float32))

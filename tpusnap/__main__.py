@@ -6,6 +6,10 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
   info        PATH      snapshot version, world size, size breakdown
   ls          PATH [-l] list manifest entries (one line per logical path)
   verify      PATH      stream-verify every blob against recorded CRCs
+                        (exit 2 = corruption, 3 = NOTHING was verifiable
+                        — checksums disabled at take or a different
+                        checksum build; scripts must not read that as
+                        "verified clean")
   cat         PATH MANIFEST_PATH  read one object (``read_object``), print it
   materialize PATH      copy base-referenced blobs into an incremental
                         snapshot so its bases can be deleted
@@ -16,7 +20,8 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
                         increment referencing a doomed base is
                         materialized first, then the rest are deleted
 
-Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found.
+Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
+(or provably-different diff), 3 undecidable/unverifiable.
 """
 
 from __future__ import annotations
@@ -90,7 +95,12 @@ def cmd_info(args) -> int:
     if external:
         from .inspect import base_root_of_location
 
-        bases = sorted({base_root_of_location(b.location) for b in external})
+        bases = sorted(
+            {
+                base_root_of_location(b.location, md.base_roots)
+                for b in external
+            }
+        )
         print(
             f"external:    {len(external)} blob range(s) reference base "
             f"snapshot(s): {', '.join(bases)} — keep them alive (or "
@@ -136,7 +146,20 @@ def cmd_verify(args) -> int:
         for u in report.unverified_blobs:
             print(f"UNVERIFIED  {u.manifest_path}: {u.detail}")
     print(report.summary())
-    return 0 if report.clean else 2
+    if not report.clean:
+        return 2
+    # "Nothing was verifiable" must not read as "verified clean" in
+    # scripts (snapshot taken with TPUSNAP_DISABLE_CHECKSUM=1, or by a
+    # build with a different checksum algorithm): exit 3, mirroring
+    # diff's 3 = undecidable convention.
+    if report.ok == 0 and report.unverified > 0:
+        print(
+            "nothing verified: no blob carries a checksum this build can "
+            "check",
+            file=sys.stderr,
+        )
+        return 3
+    return 0
 
 
 def cmd_materialize(args) -> int:

@@ -174,13 +174,26 @@ class StoragePlugin(abc.ABC):
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None: ...
 
-    async def write_atomic(self, write_io: WriteIO) -> None:
+    async def write_atomic(self, write_io: WriteIO, durable: bool = False) -> None:
         """Write that either fully lands or leaves any existing object
         untouched. Object stores are per-PUT atomic already, so the
         default delegates to ``write``; filesystem plugins override with
         temp-file + rename (a plain truncate-then-write would destroy a
         previously valid file on a mid-write crash — this matters when
-        REWRITING committed metadata, e.g. ``materialize``)."""
+        REWRITING committed metadata, e.g. ``materialize``).
+
+        ``durable=True`` additionally makes the committed object survive
+        POWER LOSS before returning (fs: fsync the temp file, rename,
+        then fsync every directory the plugin created — so blob dirents
+        written before the commit become durable with it; object stores
+        are durable per PUT already). Callers rewriting
+        already-committed metadata pass True (cheap there and the
+        downside is destroying good state); the take commit passes the
+        TPUSNAP_DURABLE_COMMIT knob, which ALSO fsyncs each blob file
+        at write time — fsyncs right after a multi-GB take force a
+        storage-cache flush of everything just written (~seconds), a
+        cost the baselines it is benchmarked against (torch.save, the
+        reference) never pay."""
         await self.write(write_io)
 
     @abc.abstractmethod
@@ -188,6 +201,21 @@ class StoragePlugin(abc.ABC):
 
     @abc.abstractmethod
     async def delete(self, path: str) -> None: ...
+
+    async def flush_created_dirs(self) -> None:
+        """Make the dirents of everything this plugin instance created
+        durable (fs: fsync each created directory). Called by EVERY rank
+        after its writes drain, before the commit barrier, when
+        TPUSNAP_DURABLE_COMMIT is on — the committing rank's
+        ``write_atomic(durable=True)`` can only fsync its OWN
+        directories, not the ones other ranks' plugin instances made.
+        Default no-op (object stores have no dirents)."""
+        return None
+
+    def sync_flush_created_dirs(
+        self, event_loop: Optional[asyncio.AbstractEventLoop] = None
+    ) -> None:
+        _run(self.flush_created_dirs(), event_loop)
 
     async def close(self) -> None:  # optional override
         return None
@@ -200,9 +228,12 @@ class StoragePlugin(abc.ABC):
         _run(self.write(write_io), event_loop)
 
     def sync_write_atomic(
-        self, write_io: WriteIO, event_loop: Optional[asyncio.AbstractEventLoop] = None
+        self,
+        write_io: WriteIO,
+        event_loop: Optional[asyncio.AbstractEventLoop] = None,
+        durable: bool = False,
     ) -> None:
-        _run(self.write_atomic(write_io), event_loop)
+        _run(self.write_atomic(write_io, durable=durable), event_loop)
 
     def sync_read(
         self, read_io: ReadIO, event_loop: Optional[asyncio.AbstractEventLoop] = None

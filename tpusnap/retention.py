@@ -90,7 +90,7 @@ def _referenced_bases(snap_path: str) -> List[str]:
     bases = set()
     for blob in iter_blobs(md.manifest):
         if blob.location.startswith("../"):
-            base = base_root_of_location(blob.location)
+            base = base_root_of_location(blob.location, md.base_roots)
             bases.add(os.path.abspath(os.path.join(snap_path, base)))
     return sorted(bases)
 

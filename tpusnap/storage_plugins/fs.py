@@ -70,10 +70,31 @@ class FSStoragePlugin(StoragePlugin):
         else:
             async with aiofiles.open(path, "wb") as f:
                 await f.write(buf)
+        if _durable_commit():
+            # Durable-commit mode: every blob's DATA must be on stable
+            # storage before the metadata commit declares the snapshot
+            # durable — fsync on the metadata file alone does not write
+            # back other files' dirty pages (small blobs and fallback
+            # engines go through the page cache). Dirent durability is
+            # handled at commit time (write_atomic fsyncs every
+            # directory this plugin created).
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                self._get_executor(), _fsync_path, str(path)
+            )
 
-    async def write_atomic(self, write_io: WriteIO) -> None:
+    async def write_atomic(self, write_io: WriteIO, durable: bool = False) -> None:
         """Temp-file + rename: a crash mid-write never destroys an
-        existing file at the destination."""
+        existing file at the destination. With ``durable=True`` the temp
+        file is fsync'd before the rename and the parent directory
+        after, so a power loss after return can never leave the rename
+        durable with the DATA not (an empty/torn ``.snapshot_metadata``)
+        nor lose the commit. The fsync is caller-opted because its cost
+        is NOT metadata-sized: an fsync right after a multi-GB take
+        flushes the storage cache of everything just written (~2 s
+        measured here) — callers rewriting already-committed metadata
+        always opt in, the take commit does so via
+        TPUSNAP_DURABLE_COMMIT (see io_types.write_atomic)."""
         path = pathlib.Path(os.path.join(self.root, write_io.path))
         self._ensure_parent(path)
         tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
@@ -82,7 +103,17 @@ class FSStoragePlugin(StoragePlugin):
         def work():
             try:
                 _write_file(tmp, write_io.buf)
+                if durable:
+                    _fsync_path(str(tmp))
                 os.replace(tmp, path)
+                if durable:
+                    # Every directory this plugin created, plus the
+                    # commit's own parent: the dirents of the blobs
+                    # written before this commit become durable with it.
+                    for d in {str(p) for p in self._dir_cache} | {
+                        str(path.parent)
+                    }:
+                        _fsync_path(d)
             except BaseException:
                 try:
                     os.unlink(tmp)
@@ -179,10 +210,40 @@ class FSStoragePlugin(StoragePlugin):
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, os.remove, full)
 
+    async def flush_created_dirs(self) -> None:
+        """fsync every directory this instance created (durable-commit
+        mode: each rank runs this after its writes drain, so dirents of
+        all ranks' blobs are stable before rank 0 commits)."""
+        dirs = {str(p) for p in self._dir_cache} | {self.root}
+        loop = asyncio.get_running_loop()
+
+        def work():
+            for d in dirs:
+                try:
+                    _fsync_path(d)
+                except OSError:
+                    pass  # deleted/renamed since creation
+
+        await loop.run_in_executor(self._get_executor(), work)
+
     async def close(self) -> None:
         if self._executor is not None:
             self._executor.shutdown(wait=True)
             self._executor = None
+
+
+def _durable_commit() -> bool:
+    from ..knobs import is_durable_commit_enabled
+
+    return is_durable_commit_enabled()
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _write_file(path: pathlib.Path, buf) -> None:
