@@ -12,6 +12,15 @@ from typing import Any, Dict, Protocol, runtime_checkable
 
 @runtime_checkable
 class Stateful(Protocol):
+    """Optional class attribute ``load_requires_collectives: bool``
+    (default False when absent): set True when ``load_state_dict`` runs
+    device collectives (e.g. an all-gather to re-materialize a sharded
+    optimizer). Such statefuls need ``restore(per_key_barrier=True)``
+    for cross-rank ordering, and ``async_restore`` REJECTS them —
+    collectives on the background restore thread run unordered against
+    other ranks and deadlock or corrupt (the same discipline as the
+    reference's no-collectives-off-thread rule, snapshot.py:902)."""
+
     def state_dict(self) -> Dict[str, Any]: ...
 
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None: ...
