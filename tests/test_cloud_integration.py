@@ -1,0 +1,101 @@
+"""Real-bucket cloud-storage integration tests, secret/env gated.
+
+Mirrors the reference's gated integration suites
+(/root/reference/tests/test_s3_storage_plugin.py:29-49,
+tests/test_gcs_storage_plugin.py): each class skips entirely unless its
+bucket env var is set (CI provides them from repo secrets; local runs
+skip), and a health-check fixture skips — not fails — on flaky access,
+so missing cloud permissions never mask code regressions.
+
+Covered per backend: raw plugin round-trip (write/read/ranged
+read/delete), and a full Snapshot take -> verify -> restore cycle
+against the real service.
+"""
+
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+from tpusnap import Snapshot, StateDict, verify_snapshot
+from tpusnap.io_types import ReadIO, WriteIO
+
+_S3_BUCKET = os.environ.get("TPUSNAP_TEST_S3_BUCKET")
+_GCS_BUCKET = os.environ.get("TPUSNAP_TEST_GCS_BUCKET")
+
+
+def _plugin_round_trip(url: str) -> None:
+    import asyncio
+
+    from tpusnap.storage_plugin import url_to_storage_plugin_in_event_loop
+
+    loop = asyncio.new_event_loop()
+    plugin = url_to_storage_plugin_in_event_loop(url, loop)
+    try:
+        payload = np.arange(100_000, dtype=np.uint8).tobytes()
+        plugin.sync_write(WriteIO(path="blob", buf=payload), loop)
+        read_io = ReadIO(path="blob")
+        plugin.sync_read(read_io, loop)
+        assert read_io.buf.getvalue() == payload
+        ranged = ReadIO(path="blob", byte_range=(10, 50))
+        plugin.sync_read(ranged, loop)
+        assert ranged.buf.getvalue() == payload[10:50]
+        loop.run_until_complete(plugin.delete("blob"))
+    finally:
+        plugin.sync_close(loop)
+        loop.close()
+
+
+def _snapshot_round_trip(url: str) -> None:
+    state = StateDict(
+        w=np.random.default_rng(0).standard_normal((256, 32)).astype(np.float32),
+        step=7,
+    )
+    Snapshot.take(url, {"app": state})
+    assert verify_snapshot(url).clean
+    target = {"app": StateDict(w=np.zeros((256, 32), np.float32), step=0)}
+    Snapshot(url).restore(target)
+    assert target["app"]["step"] == 7
+    assert np.array_equal(target["app"]["w"], state["w"])
+
+
+def _health_check(url: str) -> None:
+    """Probe the bucket once; unreachable/permission problems skip the
+    suite instead of failing it (reference test_s3_storage_plugin.py:29-45)."""
+    try:
+        _plugin_round_trip(url + "/healthcheck")
+    except Exception as e:  # noqa: BLE001 - any cloud failure means skip
+        pytest.skip(f"cloud bucket {url} not usable from here: {e}")
+
+
+@pytest.mark.s3_integration_test
+@pytest.mark.skipif(not _S3_BUCKET, reason="TPUSNAP_TEST_S3_BUCKET not set")
+class TestS3Integration:
+    @pytest.fixture(autouse=True)
+    def _prefix(self):
+        pytest.importorskip("aiobotocore")
+        self.url = f"s3://{_S3_BUCKET}/tpusnap_ci/{uuid.uuid4().hex}"
+        _health_check(self.url)
+
+    def test_plugin_round_trip(self):
+        _plugin_round_trip(self.url + "/plugin")
+
+    def test_snapshot_round_trip(self):
+        _snapshot_round_trip(self.url + "/snap")
+
+
+@pytest.mark.gcs_integration_test
+@pytest.mark.skipif(not _GCS_BUCKET, reason="TPUSNAP_TEST_GCS_BUCKET not set")
+class TestGCSIntegration:
+    @pytest.fixture(autouse=True)
+    def _prefix(self):
+        pytest.importorskip("google.auth")
+        self.url = f"gs://{_GCS_BUCKET}/tpusnap_ci/{uuid.uuid4().hex}"
+        _health_check(self.url)
+
+    def test_plugin_round_trip(self):
+        _plugin_round_trip(self.url + "/plugin")
+
+    def test_snapshot_round_trip(self):
+        _snapshot_round_trip(self.url + "/snap")
