@@ -1,0 +1,94 @@
+"""pinned_host (UVM-analog) probe — run on the real chip by bench.py.
+
+Creates a ``memory_kind="pinned_host"`` array on the default backend
+(the real TPU when the driver runs the bench), snapshots it, restores it
+into a pinned_host target, and reports whether the memory kind survived
+the round trip — the on-hardware proof of the host-offload capability
+(reference uvm_tensor.py:24-39 + tests/gpu_tests/test_torchrec.py:181-262
+prove theirs on GPU). Deliberately tiny (4 MB): this environment's
+PJRT tunnel moves ~10 MB/s device->host, and the probe measures
+capability, not bandwidth. Prints ONE JSON line; never raises (the
+caller treats a hang via subprocess timeout — the tunnel is known to
+wedge for minutes).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+
+def main() -> None:
+    out = {"ok": False}
+    work = None
+    try:
+        # Honor JAX_PLATFORMS if the caller set one (local CPU testing);
+        # default — the driver's bench run — is the real chip.
+        from tpusnap.test_utils import apply_platform_env
+
+        apply_platform_env()
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        dev = jax.devices()[0]
+        out["platform"] = dev.platform
+        from tpusnap.host_offload import (
+            is_host_resident,
+            supports_host_offload,
+            to_host_offload,
+        )
+
+        if not supports_host_offload(dev):
+            out["error"] = "backend lacks host memory kinds"
+            return
+        n = 1 << 20  # 4 MB of f32
+        arr = jax.device_put(jnp.arange(n, dtype=jnp.float32), dev)
+        offloaded = to_host_offload(arr)
+        out["host_resident"] = bool(is_host_resident(offloaded))
+
+        from tpusnap import PytreeState, Snapshot
+
+        work = tempfile.mkdtemp(prefix="tpusnap_phprobe_")
+        snap = work + "/snap"
+        Snapshot.take(snap, {"m": PytreeState({"table": offloaded})})
+        target = {
+            "m": PytreeState(
+                {
+                    "table": to_host_offload(
+                        jax.device_put(jnp.zeros(n, jnp.float32), dev)
+                    )
+                }
+            )
+        }
+        Snapshot(snap).restore(target)
+        restored = target["m"].tree["table"]
+        out["restored_memory_kind"] = getattr(
+            restored.sharding, "memory_kind", None
+        )
+        out["values_equal"] = bool(
+            np.array_equal(np.asarray(restored), np.asarray(arr))
+        )
+        out["ok"] = (
+            out["values_equal"]
+            and out["restored_memory_kind"] == "pinned_host"
+        )
+    except Exception as e:  # noqa: BLE001 - report, never crash the bench
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if work:
+            shutil.rmtree(work, ignore_errors=True)
+        print(json.dumps(out))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
