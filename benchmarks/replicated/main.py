@@ -56,29 +56,55 @@ def worker(work_dir: str, gb: str) -> None:
         os.path.join(work_dir, "snap"), {"m": PytreeState(state)}, replicated=["**"]
     )
     take_s = time.perf_counter() - t0
+    # Per-rank write volume: the partitioner's whole point is spreading
+    # the replicated bytes over every rank (reference
+    # benchmarks/ddp/README.md:15-24 scales BECAUSE of this); the
+    # per-rank split is the direct evidence.
+    from tpusnap import scheduler as _sched
+
+    my_bytes = _sched.LAST_EXECUTION_STATS.get("write", {}).get("bytes", 0)
+    per_rank = comm.all_gather_object(my_bytes)
     if rank == 0:
+        split = ", ".join(f"r{r}={b / 1e6:.0f}MB" for r, b in enumerate(per_rank))
         print(f"Snapshot.take (replicated, world={comm.world_size}): "
-              f"{take_s:.2f}s ({nbytes / take_s / 1e9:.2f} GB/s)")
+              f"{take_s:.2f}s ({nbytes / take_s / 1e9:.2f} GB/s) "
+              f"per-rank bytes written: [{split}]")
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--world-size", type=int, default=2)
     parser.add_argument("--gb", type=float, default=1.0)
+    parser.add_argument(
+        "--sweep",
+        type=str,
+        default=None,
+        help="comma-separated world sizes (e.g. 1,2,4) — the reference's "
+        "scaling-table shape (benchmarks/ddp/README.md:15-24). On a "
+        "1-vCPU host aggregate throughput cannot scale (every rank "
+        "shares one core and one disk); the table records per-rank "
+        "write-load spread and the multi-process overhead instead.",
+    )
     args = parser.parse_args()
 
     from tpusnap.test_utils import run_subprocess_world
 
-    with tempfile.TemporaryDirectory(prefix="tpusnap_bench_repl_") as work_dir:
-        outputs = run_subprocess_world(
-            worker,
-            world_size=args.world_size,
-            args=[work_dir, str(args.gb)],
-            timeout=600.0,
-        )
-    for line in outputs[0].strip().splitlines():
-        if "GB/s" in line:
-            print(line)
+    worlds = (
+        [int(w) for w in args.sweep.split(",")]
+        if args.sweep
+        else [args.world_size]
+    )
+    for world in worlds:
+        with tempfile.TemporaryDirectory(prefix="tpusnap_bench_repl_") as work_dir:
+            outputs = run_subprocess_world(
+                worker,
+                world_size=world,
+                args=[work_dir, str(args.gb)],
+                timeout=600.0,
+            )
+        for line in outputs[0].strip().splitlines():
+            if "GB/s" in line:
+                print(line)
 
 
 if __name__ == "__main__":
