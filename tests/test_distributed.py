@@ -551,3 +551,85 @@ def test_overlapping_async_takes():
         run_subprocess_world(
             _world_overlapping_async_takes, world_size=2, args=[f"{d}/snap"]
         )
+
+
+def _world_tile_grain_incremental(snap_dir):
+    """World-2 incremental chain mixing per-rank dense state (tile-grain
+    dedup active), replicated state (tile route DISABLED in multi —
+    the write-load estimator's unit ids must stay blob-grain on every
+    rank), and sharded state (blob-grain shard dedup)."""
+    import numpy as np
+
+    import jax
+
+    from tpusnap import PytreeState, Snapshot, StateDict, verify_snapshot
+    from tpusnap.comm import get_communicator
+    from tpusnap.knobs import (
+        override_record_dedup_hashes,
+        override_tile_checksum_bytes,
+    )
+
+    comm = get_communicator()
+    rank = comm.rank
+
+    def state(step):
+        # per-rank dense (1024, 64) f32 = 256 KiB -> 4 KiB tiles
+        local = (
+            np.arange(1024 * 64, dtype=np.float32).reshape(1024, 64)
+            + rank * 1000
+        )
+        if step:
+            local = local.copy()
+            local[500, :] += step  # one row -> one tile
+        repl = np.full((2048,), 7.0, np.float32)  # identical on all ranks
+        if step:
+            repl = repl + step
+        return StateDict(local=local, repl=repl, step=step)
+
+    with override_tile_checksum_bytes(4 * 1024), override_record_dedup_hashes(
+        True
+    ):
+        Snapshot.take(
+            f"{snap_dir}/s0", {"app": state(0)}, replicated=["app/repl"]
+        )
+        comm.barrier()
+        Snapshot.take(
+            f"{snap_dir}/s1",
+            {"app": state(1)},
+            replicated=["app/repl"],
+            incremental_from=f"{snap_dir}/s0",
+        )
+    comm.barrier()
+    if rank == 0:
+        # Each rank's dense blob wrote ~one 4 KiB tile, not 256 KiB;
+        # repl rewrote whole (tile route off for multi replicated).
+        total = 0
+        for dirpath, _, files in os.walk(f"{snap_dir}/s1"):
+            for f in files:
+                if f != ".snapshot_metadata":
+                    total += os.path.getsize(os.path.join(dirpath, f))
+        assert total < 64 * 1024, f"s1 wrote {total} bytes"
+        assert verify_snapshot(f"{snap_dir}/s1").clean
+    comm.barrier()
+    target = {
+        "app": StateDict(
+            local=np.zeros((1024, 64), np.float32),
+            repl=np.zeros((2048,), np.float32),
+            step=-1,
+        )
+    }
+    Snapshot(f"{snap_dir}/s1").restore(target)
+    expect = state(1)
+    np.testing.assert_array_equal(target["app"]["local"], expect["local"])
+    np.testing.assert_array_equal(target["app"]["repl"], expect["repl"])
+    assert target["app"]["step"] == 1
+
+
+def test_tile_grain_incremental_world2():
+    """Tile-grain dedup in a real 2-process world: per-rank tiles skip,
+    replicated entries stay blob-grain (no estimator drift), restore and
+    scrub resolve the mixed form."""
+    with tempfile.TemporaryDirectory() as d:
+        run_subprocess_world(
+            _world_tile_grain_incremental, world_size=2, args=[f"{d}/snap"]
+        )
