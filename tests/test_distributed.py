@@ -633,3 +633,36 @@ def test_tile_grain_incremental_world2():
         run_subprocess_world(
             _world_tile_grain_incremental, world_size=2, args=[f"{d}/snap"]
         )
+
+
+def _world_durable_commit(snap_dir):
+    """TPUSNAP_DURABLE_COMMIT in a 2-process world: every rank flushes
+    its own created dirents before the commit barrier; the committed
+    snapshot restores and scrubs on both ranks."""
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict, verify_snapshot
+    from tpusnap.comm import get_communicator
+
+    os.environ["TPUSNAP_DURABLE_COMMIT"] = "1"
+    comm = get_communicator()
+    rank = comm.rank
+    local = np.arange(4096, dtype=np.float32) + rank
+    Snapshot.take(f"{snap_dir}/s0", {"app": StateDict(local=local)})
+    # async path exercises the background-thread flush too
+    Snapshot.async_take(f"{snap_dir}/s1", {"app": StateDict(local=local)}).wait()
+    comm.barrier()
+    for s in ("s0", "s1"):
+        target = {"app": StateDict(local=np.zeros(4096, np.float32))}
+        Snapshot(f"{snap_dir}/{s}").restore(target)
+        np.testing.assert_array_equal(target["app"]["local"], local)
+    if rank == 0:
+        assert verify_snapshot(f"{snap_dir}/s0").clean
+        assert verify_snapshot(f"{snap_dir}/s1").clean
+
+
+def test_durable_commit_world2():
+    with tempfile.TemporaryDirectory() as d:
+        run_subprocess_world(
+            _world_durable_commit, world_size=2, args=[f"{d}/snap"]
+        )

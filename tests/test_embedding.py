@@ -318,3 +318,25 @@ class TestEmbeddingIncremental:
         tgt_state = PytreeState(target)
         Snapshot(inc).restore({"emb": tgt_state})
         _assert_tree_equal(_gather(tgt_state.tree), _gather(params2))
+
+
+def test_host_resident_arrays_still_clone_on_async_take():
+    """_may_alias_live_memory: device arrays on non-CPU backends skip
+    the async defensive clone (their host copy cannot alias donated
+    HBM), but host-RESIDENT (pinned_host, the UVM analog) arrays alias
+    host memory on any backend and must keep cloning — as must CPU
+    device arrays and plain numpy."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusnap.host_offload import supports_host_offload, to_host_offload
+    from tpusnap.io_preparers.array import _may_alias_live_memory
+
+    arr_np = np.arange(8, dtype=np.float32)
+    assert _may_alias_live_memory(arr_np, arr_np)
+    dev = jnp.arange(8, dtype=jnp.float32)  # CPU backend in tests
+    assert _may_alias_live_memory(dev, np.asarray(dev))
+    if supports_host_offload():
+        offl = to_host_offload(dev)
+        assert _may_alias_live_memory(offl, np.asarray(offl))
