@@ -1,0 +1,92 @@
+"""Staging-buffer pool (tpusnap/_staging_pool.py): the async-clone
+warm-page reuse and its safety properties — exact-size reuse, oldest-
+first eviction at the cap, leak-proof outstanding tracking, and
+non-pool buffers being ignored."""
+
+import numpy as np
+import pytest
+
+import tpusnap._staging_pool as pool
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pool():
+    pool.clear()
+    yield
+    pool.clear()
+
+
+def test_exact_size_reuse():
+    a = pool.acquire(1 << 20)
+    ptr = a.ctypes.data
+    assert pool.release(a) is True
+    b = pool.acquire(1 << 20)
+    assert b.ctypes.data == ptr  # same (warm) buffer handed back
+    # A different size misses and allocates fresh.
+    c = pool.acquire(2 << 20)
+    assert c.ctypes.data != ptr
+
+
+def test_release_ignores_foreign_buffers():
+    user = np.zeros(1 << 20, np.uint8)
+    assert pool.release(user) is False
+    assert pool.release(memoryview(user)) is False
+    assert pool.release(b"bytes") is False
+
+
+def test_cap_evicts_oldest_sizes(monkeypatch):
+    monkeypatch.setenv("TPUSNAP_STAGING_POOL_BYTES", str(3 << 20))
+    old = pool.acquire(2 << 20)
+    old_ptr = old.ctypes.data
+    assert pool.release(old) is True
+    # A new size that would exceed the cap evicts the OLD entry instead
+    # of being dropped — shape changes age stale sizes out.
+    new = pool.acquire(2 << 20 | 4096)
+    assert pool.release(new) is True
+    reacquired_old = pool.acquire(2 << 20)
+    assert reacquired_old.ctypes.data != old_ptr  # old was evicted
+
+    # Buffers above the cap are never retained.
+    monkeypatch.setenv("TPUSNAP_STAGING_POOL_BYTES", str(1 << 20))
+    big = pool.acquire(2 << 20)
+    assert pool.release(big) is False
+
+
+def test_dropped_buffers_do_not_leak_tracking():
+    a = pool.acquire(1 << 20)
+    a_id = id(a)
+    del a  # abort path: buffer garbage-collected without release()
+    pool.acquire(4096)  # prunes dead outstanding entries
+    assert all(k != a_id or r() is not None
+               for k, r in pool._outstanding.items())
+
+
+def test_double_release_is_inert():
+    a = pool.acquire(1 << 20)
+    assert pool.release(a) is True
+    # Second release of the same (now-free) buffer must not double-add.
+    assert pool.release(a) is False
+    assert pool._free_bytes == 1 << 20
+
+
+def test_async_take_loop_reuses_buffers(tmp_path):
+    """End to end: the second async take's clones come from the pool."""
+    import tpusnap._staging_pool as sp
+    from tpusnap import PytreeState, Snapshot
+
+    state = {
+        f"w{i}": np.random.default_rng(i).standard_normal(1 << 17).astype(np.float32)
+        for i in range(3)
+    }  # 512 KiB each — above the pool's reuse floor, below slab batching? (they batch; members release too)
+    Snapshot.async_take(str(tmp_path / "s0"), {"m": PytreeState(state)}).wait()
+    free_after_first = sp._free_bytes
+    assert free_after_first > 0  # clones returned to the pool
+    Snapshot.async_take(str(tmp_path / "s1"), {"m": PytreeState(state)}).wait()
+    # Steady state: same sizes recycled, pool didn't grow.
+    assert sp._free_bytes == free_after_first
+    # Both snapshots independently restore bit-exact.
+    for s in ("s0", "s1"):
+        tgt = {"m": PytreeState({k: np.zeros_like(v) for k, v in state.items()})}
+        Snapshot(str(tmp_path / s)).restore(tgt)
+        for k, v in state.items():
+            assert np.array_equal(tgt["m"].tree[k], v), (s, k)

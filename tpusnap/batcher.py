@@ -95,6 +95,10 @@ class BatchedBufferStager(BufferStager):
                     f"Batched member staged {mv.nbytes} bytes, expected {nbytes}"
                 )
             slab[offset : offset + nbytes] = np.frombuffer(mv, dtype=np.uint8)
+            del mv
+            from ._staging_pool import release
+
+            release(buf)  # async member clones reuse warm pages next take
 
         await asyncio.gather(
             *(fill(i, o, n, s) for i, (o, n, s) in enumerate(self.members))

@@ -102,6 +102,14 @@ class ArrayBufferStager(BufferStager):
         # than 32 bits of evidence (small tile-less blobs record theirs
         # eagerly on every take — see _record_checksums).
         self.record_dedup_hashes = record_dedup_hashes
+        # Set by the take AFTER batching (single-process, non-incremental
+        # only): skip hashing at stage time; the write pipeline calls
+        # late_checksum with the staged buffer instead — the hash pass
+        # moves off the staging window async_take blocks training on and
+        # overlaps other requests' disk time. Multi-process manifests
+        # are gathered by value before writes complete, and incremental
+        # dedup needs hashes at stage time, so neither defers.
+        self.defer_checksums = False
         # User save-time transform (dtype cast / quantize-on-save),
         # applied to the ORIGINAL array at stage time with tracing=False
         # (reference io_preparers/tensor.py:231-241).
@@ -182,7 +190,7 @@ class ArrayBufferStager(BufferStager):
                 # values already recorded).
                 from .. import _native
 
-                out = _native.aligned_empty(mv.nbytes)
+                out = _acquire_clone_buffer(mv.nbytes)
                 _, row_nbytes = _tile_geometry(self.entry, mv.nbytes)
                 _, xxhs = _native.memcpy_crc_xxh_tiles(
                     out, mv, tile_rows * row_nbytes
@@ -199,7 +207,7 @@ class ArrayBufferStager(BufferStager):
             if clone:
                 from .. import _native
 
-                out = _native.aligned_empty(mv.nbytes)
+                out = _acquire_clone_buffer(mv.nbytes)
                 _native.memcpy(out, mv)  # checksums already recorded
                 return out
             return mv
@@ -209,10 +217,15 @@ class ArrayBufferStager(BufferStager):
             # memcpy releases the GIL (and parallelizes) for large clones
             # — and when checksums are on, the CRC is computed INSIDE the
             # clone pass (one read per byte instead of two), since the
-            # clone is the async take's blocked time.
+            # clone is the async take's blocked time. In deferred mode
+            # the clone is a plain memcpy and hashing happens on the
+            # write path (late_checksum).
             from .. import _native
 
-            out = _native.aligned_empty(mv.nbytes)
+            out = _acquire_clone_buffer(mv.nbytes)
+            if want_crc and self.defer_checksums:
+                _native.memcpy(out, mv)
+                return out
             if want_crc:
                 tile_rows, row_nbytes = _tile_geometry(self.entry, mv.nbytes)
                 want_dedup = _want_dedup_hashes(
@@ -259,9 +272,28 @@ class ArrayBufferStager(BufferStager):
             else:
                 _native.memcpy(out, mv)
             return out
-        if want_crc:
+        if want_crc and not self.defer_checksums:
             _record_checksums(self.entry, mv, self.record_dedup_hashes)
         return mv
+
+    def late_checksum(self, buf) -> None:
+        """Record checksums from the STAGED buffer — called by the write
+        pipeline when ``defer_checksums`` is set (the buffer is stable:
+        either the caller's own memory on a sync take or the defensive
+        clone on an async one)."""
+        from ..knobs import is_checksum_disabled
+
+        if (
+            self.entry is None
+            or is_checksum_disabled()
+            or self.entry.checksum is not None
+        ):
+            return
+        _record_checksums(
+            self.entry,
+            memoryview(buf).cast("B"),
+            self.record_dedup_hashes,
+        )
 
     def get_staging_cost_bytes(self) -> int:
         if self.array_prepare_func is not None and self.entry is not None:
@@ -282,7 +314,10 @@ def _may_alias_live_memory(arr: ArrayLike, host: np.ndarray) -> bool:
     device array materializes a fresh host copy via DtoH — donation
     reuses device HBM, never that host buffer — so async takes on real
     accelerators skip the defensive clone entirely and their blocked
-    time is just DMA + hash. On CPU backends the "host copy" is a VIEW
+    time is DMA plus the hash pass (single-process takes defer even the
+    hash to the write path; multi-host takes gather manifests by value
+    before writes complete and still hash in the blocked window). On
+    CPU backends the "host copy" is a VIEW
     of the XLA buffer, and host-resident (pinned_host, the UVM analog)
     arrays alias host memory on any backend; numpy sources alias the
     caller's array by construction — all of those clone."""
@@ -296,6 +331,17 @@ def _may_alias_live_memory(arr: ArrayLike, host: np.ndarray) -> bool:
         except Exception:
             return True
     return True
+
+
+def _acquire_clone_buffer(nbytes: int):
+    """Aligned buffer for the async defensive clone, from the staging
+    pool: steady-state checkpoint loops reuse warm pages instead of
+    paying ~1 GB/s first-touch page zeroing per take (the dominant cost
+    of the blocked window on CPU-backend hosts). The write pipeline
+    returns it to the pool after the write."""
+    from .._staging_pool import acquire
+
+    return acquire(nbytes)
 
 
 def writable_byte_view(

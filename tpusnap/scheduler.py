@@ -240,15 +240,24 @@ class PendingIOWork:
 
 
 class _WritePipeline:
-    def __init__(self, write_req: WriteReq, storage: StoragePlugin) -> None:
+    def __init__(
+        self,
+        write_req: WriteReq,
+        storage: StoragePlugin,
+        executor: Optional[ThreadPoolExecutor] = None,
+    ) -> None:
         self.write_req = write_req
         self.storage = storage
+        self.executor = executor
         self.staging_cost = write_req.buffer_stager.get_staging_cost_bytes()
         self.buf = None
         self.buf_size = 0
         # True when the stager reported the content is already persisted
         # (incremental dedup): the request completes with no storage I/O.
         self.skipped = False
+        # True when the written buffer was retained by the staging pool
+        # (still resident — its bytes must not be credited back).
+        self.pool_retained = False
 
     async def stage(self, executor: ThreadPoolExecutor) -> "_WritePipeline":
         from .io_types import SKIP_WRITE
@@ -264,7 +273,29 @@ class _WritePipeline:
         return self
 
     async def write(self) -> "_WritePipeline":
+        stager = self.write_req.buffer_stager
+        if getattr(stager, "defer_checksums", False) and self.buf is not None:
+            # Deferred hashing (single-process, non-incremental takes):
+            # checksums computed HERE, on the write path — overlapping
+            # other requests' disk time instead of occupying the staging
+            # window async_take blocks training on. The values land in
+            # the same entry objects the manifest references, before the
+            # post-drain metadata commit.
+            late = getattr(stager, "late_checksum", None)
+            if late is not None:
+                loop = asyncio.get_running_loop()
+                if self.executor is not None:
+                    await loop.run_in_executor(self.executor, late, self.buf)
+                else:
+                    late(self.buf)
         await self.storage.write(WriteIO(path=self.write_req.path, buf=self.buf))
+        # Async-clone buffers go back to the staging pool (warm pages
+        # for the next take's blocked window); other buffers are ignored
+        # by release(). Retained buffers stay RESIDENT, so the budget
+        # loop must not credit their bytes back (pool_retained).
+        from ._staging_pool import release
+
+        self.pool_retained = release(self.buf)
         self.buf = None  # release host memory
         return self
 
@@ -283,7 +314,7 @@ async def execute_write_reqs(
     # overlaps with the staging of everything behind them.
     pipelines = deque(
         sorted(
-            (_WritePipeline(wr, storage) for wr in write_reqs),
+            (_WritePipeline(wr, storage, executor) for wr in write_reqs),
             key=lambda p: p.staging_cost,
             reverse=True,
         )
@@ -340,7 +371,11 @@ async def execute_write_reqs(
                 elif task in io_tasks:
                     io_tasks.discard(task)
                     pipeline = task.result()
-                    budget += pipeline.buf_size
+                    # Pool-retained buffers are still resident: their
+                    # bytes are NOT free memory and must not re-enter
+                    # the staging budget.
+                    if not pipeline.pool_retained:
+                        budget += pipeline.buf_size
                     reporter.report_request_done(pipeline.buf_size)
             dispatch_io(ready_for_io)
             dispatch_staging()

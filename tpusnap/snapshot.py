@@ -728,6 +728,20 @@ def _take_impl(
     entries_list, write_reqs = batch_write_requests(entries_list, write_reqs)
     entries = dict(zip(entries.keys(), entries_list))
 
+    # Single-process, non-incremental takes hash on the WRITE path
+    # instead of the staging window (see ArrayBufferStager.defer_checksums):
+    # with world_size == 1 the gathered manifest holds the SAME entry
+    # objects the stagers annotate, and the metadata commit runs after
+    # the writes drain — so late-recorded checksums land in it. Applied
+    # after batching: slab members hash inside their slab's staging (the
+    # member write reqs no longer exist to carry a late hash).
+    if comm.world_size == 1 and incremental_from is None:
+        from .io_preparers.array import ArrayBufferStager
+
+        for wr in write_reqs:
+            if isinstance(wr.buffer_stager, ArrayBufferStager):
+                wr.buffer_stager.defer_checksums = True
+
     memory_budget = get_process_memory_budget_bytes(
         comm, local_world_size=local_world_size
     )
