@@ -41,6 +41,12 @@ result is interpretable on any disk:
     ``restore_roofline_verified_fraction`` is the honest pipeline
     efficiency; the prefaulted-minus-verified spread is pure checksum
     cost (one fused pass, ~5 GB/s on this host's single core).
+  - ``restore_warm_gbps``: restore into already-faulted targets — the
+    PRODUCTION case (a resume loop restores into existing training
+    state). ``restore_gbps`` uses brand-new cold buffers, the worst
+    case: at high memory commit the kernel's fresh-anon-page zeroing
+    collapses (raw engine 0.18 GB/s at 20 GB here), an artifact of the
+    fresh-buffer benchmark shape, not of the restore pipeline.
   Restore reads land IN PLACE in the target arrays (native fused
   read+checksum, no scratch buffer, no separate verify/copy passes), so
   the verified restore tracks the fresh-destination roofline closely.
@@ -236,15 +242,33 @@ def main() -> None:
         # and restore are sampled interleaved (same reasoning as the
         # write side below).
         restore_runs = []
+        restore_warm_runs = []
         restore_rooflines = []
         restore_rooflines_prefaulted = []
         restore_rooflines_verified = []
+        # Warm-target restore destinations — the PRODUCTION case: a
+        # resume loop restores into long-lived existing training state
+        # whose pages are already faulted. Allocated ONCE and reused
+        # across runs, like real training state. (The fresh
+        # np.empty_like targets below are the worst case; at high
+        # memory commit the kernel's fresh-anon-page zeroing collapses
+        # — measured 0.18 GB/s raw-engine at 20 GB — an artifact of
+        # benchmarking into brand-new buffers, not of the pipeline.)
+        warm_target = {
+            f"w{i}": np.zeros_like(state[f"w{i}"]) for i in range(N_ARRAYS)
+        }
         for _ in range(3):
             restore_rooflines.append(_engine_read_all(None))
             restore_rooflines_prefaulted.append(_engine_read_all(prefaulted))
             restore_rooflines_verified.append(
                 _engine_read_all(prefaulted, want_crc=True)
             )
+            _drop_caches()
+            t0 = time.perf_counter()
+            Snapshot(restore_snap).restore(
+                {"model": PytreeState(warm_target)}
+            )
+            restore_warm_runs.append(time.perf_counter() - t0)
             cold = _drop_caches()
             target = {
                 f"w{i}": np.empty_like(state[f"w{i}"]) for i in range(N_ARRAYS)
@@ -265,8 +289,16 @@ def main() -> None:
                 state[f"w{i}"].view(np.uint16),
             )
             for i in (0, N_ARRAYS - 1)
+        ) and all(
+            # The warm-target (production-case) headline must be just as
+            # verified as the cold one.
+            np.array_equal(
+                warm_target[f"w{i}"].view(np.uint16),
+                state[f"w{i}"].view(np.uint16),
+            )
+            for i in (0, N_ARRAYS - 1)
         )
-        del target, app_state
+        del target, app_state, warm_target
         shutil.rmtree(os.path.join(bench_root, "restore_src"), ignore_errors=True)
 
         # The virtio disk's bandwidth swings >2x on multi-second timescales
@@ -455,6 +487,12 @@ def main() -> None:
                     restore_gbps / max(restore_rooflines_verified), 3
                 ),
                 "restore_runs_s": [round(t, 2) for t in restore_runs],
+                "restore_warm_gbps": round(
+                    nbytes / min(restore_warm_runs) / 1e9, 3
+                ),
+                "restore_warm_runs_s": [
+                    round(t, 2) for t in restore_warm_runs
+                ],
                 "restore_warmup_s": round(restore_warmup_s, 2),
                 "restore_cold_cache": cold,
                 "restore_verified": ok,
