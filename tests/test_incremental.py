@@ -24,6 +24,7 @@ from tpusnap.knobs import (
     override_batching_disabled,
     override_max_chunk_size_bytes,
     override_max_shard_size_bytes,
+    override_record_dedup_hashes,
     override_tile_checksum_bytes,
 )
 
@@ -205,16 +206,41 @@ def test_slab_integrity_through_dedup(tmp_path):
 
 def test_incremental_tile_grain(tmp_path):
     """Large blobs keep tile checksums through dedup; budget-tiled reads
-    of a deduped entry verify against the base's bytes."""
+    of a deduped entry verify against the base's bytes. The base take
+    records 64-bit tile hashes (the knob documented for bases of
+    planned incremental chains) — a tiled skip requires hash evidence
+    on both sides."""
     arr = np.random.default_rng(5).standard_normal((4096, 64)).astype(np.float32)
     base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
     with override_tile_checksum_bytes(128 * 1024), override_batching_disabled(True):
-        Snapshot.take(base, {"app": StateDict(big=arr)})
+        with override_record_dedup_hashes(True):
+            Snapshot.take(base, {"app": StateDict(big=arr)})
         Snapshot.take(inc, {"app": StateDict(big=arr)}, incremental_from=base)
     assert _blob_files(inc) == []
-    e = Snapshot(inc).metadata.manifest["0/app/big"]
-    assert e.tile_checksums and len(e.tile_checksums) > 1
     out = Snapshot(inc).read_object("0/app/big", memory_budget_bytes=256 * 1024)
+    assert np.array_equal(out, arr)
+
+
+def test_incremental_tiled_hashless_base_rewrites_once(tmp_path):
+    """ADVICE r4: a tiled blob over a base WITHOUT recorded tile hashes
+    must NOT skip on tile CRCs alone — the first increment rewrites
+    (recording hashes), and the second increment dedups with 64-bit
+    evidence."""
+    arr = np.random.default_rng(6).standard_normal((4096, 64)).astype(np.float32)
+    base = str(tmp_path / "s0")
+    inc1, inc2 = str(tmp_path / "s1"), str(tmp_path / "s2")
+    with override_tile_checksum_bytes(128 * 1024), override_batching_disabled(True):
+        Snapshot.take(base, {"app": StateDict(big=arr)})
+        assert (
+            Snapshot(base).metadata.manifest["0/app/big"].tile_dedup_hashes
+            is None
+        )
+        Snapshot.take(inc1, {"app": StateDict(big=arr)}, incremental_from=base)
+        # Conservative rewrite: no 64-bit evidence to match against.
+        assert _blob_files(inc1) != []
+        Snapshot.take(inc2, {"app": StateDict(big=arr)}, incremental_from=inc1)
+    assert _blob_files(inc2) == []
+    out = Snapshot(inc2).read_object("0/app/big")
     assert np.array_equal(out, arr)
 
 
@@ -873,11 +899,17 @@ def test_dedup_match_requires_64bit_evidence():
     # Either side missing the hash -> no dedup (old-format base).
     assert not dedup_entries_match(a, te())
     assert not dedup_entries_match(te(), te())
-    # Tiled entries: multiple independent CRCs suffice...
+    # Tiled entries: tile CRCs alone are NOT enough (ADVICE r4: a
+    # change confined to one tile would rest on a single 32-bit CRC) —
+    # 64-bit tile hashes must match on both sides.
     t1 = te(tile_rows=2, tile_checksums=["crc32c:01", "crc32c:02"])
     t2 = te(tile_rows=2, tile_checksums=["crc32c:01", "crc32c:02"])
-    assert dedup_entries_match(t1, t2)
-    # ...but matching tile hashes bind when both sides carry them.
+    assert not dedup_entries_match(t1, t2)
     t1.tile_dedup_hashes = ["xxh64:0a", "xxh64:0b"]
+    t2.tile_dedup_hashes = ["xxh64:0a", "xxh64:0b"]
+    assert dedup_entries_match(t1, t2)
+    # One side missing its hashes -> conservative rewrite.
+    t2.tile_dedup_hashes = None
+    assert not dedup_entries_match(t1, t2)
     t2.tile_dedup_hashes = ["xxh64:0a", "xxh64:0c"]
     assert not dedup_entries_match(t1, t2)

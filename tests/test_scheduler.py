@@ -237,3 +237,87 @@ def test_reporter_stats_and_log_split(tmp_path, caplog, monkeypatch):
     # Per-stage counts + budget appear in at least one periodic report.
     assert "ready_for_staging=" in text and "io=" in text
     assert "budget" in text
+
+
+@pytest.mark.parametrize("warm_pool", [False, True])
+def test_pooled_buffers_do_not_permanently_debit_budget(tmp_path, warm_pool):
+    """ADVICE r4: buffers the staging pool retains after a write must
+    not withhold their budget credit — withholding re-debited the same
+    resident bytes every reuse cycle, so a budget-capped take whose
+    cumulative pooled-clone bytes exceeded the budget degraded to
+    fully serialized stage-then-write. The budget governs in-flight
+    buffers only (the pool is bounded by its own cap), so staging must
+    keep overlapping storage I/O through the whole request list — both
+    from a cold pool and from a PRE-WARMED pool (a steady-state
+    checkpoint loop's second take: charging parked bytes against the
+    take while reuse re-charges them via staging_cost would serialize
+    the warm case)."""
+    import time
+
+    import tpusnap._staging_pool as sp
+
+    sp.clear()
+    unit = 1 << 16
+    n = 10
+    spans = {}
+
+    class PoolStager(BufferStager):
+        def __init__(self, path: str):
+            self.path = path
+
+        async def stage_buffer(self, executor=None):
+            spans[self.path] = [time.monotonic(), None]
+            buf = sp.acquire(unit)
+            await asyncio.sleep(0.003)
+            return buf
+
+        def get_staging_cost_bytes(self) -> int:
+            return unit
+
+    class SlowPlugin(FSStoragePlugin):
+        async def write(self, write_io) -> None:
+            await asyncio.sleep(0.02)
+            await super().write(write_io)
+            spans[write_io.path][1] = time.monotonic()
+
+    if warm_pool:
+        # Park `n` unit-sized buffers, as a previous take would have.
+        parked = [sp.acquire(unit) for _ in range(4)]
+        for b in parked:
+            assert sp.release(b) is True
+        del parked
+
+    plugin = SlowPlugin(root=str(tmp_path))
+    write_reqs = [
+        WriteReq(path=f"b{i}", buffer_stager=PoolStager(f"b{i}"))
+        for i in range(n)
+    ]
+
+    async def go():
+        pending = await execute_write_reqs(
+            write_reqs,
+            plugin,
+            memory_budget_bytes=2 * unit + unit // 2,
+            rank=0,
+        )
+        await pending.complete()
+
+    try:
+        asyncio.run(go())
+    finally:
+        sp.clear()
+
+    assert all(e is not None for _, e in spans.values())
+    # Look only at the SECOND half (by stage start): the old accounting
+    # was correct early and only seized up once retained bytes crossed
+    # the budget.
+    tail = sorted(spans.values())[n // 2 :]
+    overlaps = sum(
+        1
+        for i, a in enumerate(tail)
+        for j, b in enumerate(tail)
+        if i != j and a[0] < b[1] and b[0] < a[1]
+    )
+    assert overlaps > 0, (
+        "budget-capped pooled take degraded to serialized stage-then-write"
+    )

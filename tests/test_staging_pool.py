@@ -66,7 +66,7 @@ def test_double_release_is_inert():
     assert pool.release(a) is True
     # Second release of the same (now-free) buffer must not double-add.
     assert pool.release(a) is False
-    assert pool._free_bytes == 1 << 20
+    assert pool.free_bytes() == 1 << 20
 
 
 def test_async_take_loop_reuses_buffers(tmp_path):
@@ -79,11 +79,11 @@ def test_async_take_loop_reuses_buffers(tmp_path):
         for i in range(3)
     }  # 512 KiB each — above the pool's reuse floor, below slab batching? (they batch; members release too)
     Snapshot.async_take(str(tmp_path / "s0"), {"m": PytreeState(state)}).wait()
-    free_after_first = sp._free_bytes
+    free_after_first = sp.free_bytes()
     assert free_after_first > 0  # clones returned to the pool
     Snapshot.async_take(str(tmp_path / "s1"), {"m": PytreeState(state)}).wait()
     # Steady state: same sizes recycled, pool didn't grow.
-    assert sp._free_bytes == free_after_first
+    assert sp.free_bytes() == free_after_first
     # Both snapshots independently restore bit-exact.
     for s in ("s0", "s1"):
         tgt = {"m": PytreeState({k: np.zeros_like(v) for k, v in state.items()})}

@@ -1158,6 +1158,13 @@ static uint64_t xxh_finalize(const Xxh64State& s, uint64_t seed,
 }
 
 uint64_t ts_xxh64(const void* buf, size_t n, uint64_t seed) {
+  if (n == 0) {
+    // Callers may pass NULL for empty input; `p + consumed` on a null
+    // pointer is UB, so finalize the empty stream without touching it.
+    Xxh64State s0(seed);
+    static const char kEmpty = 0;
+    return xxh_finalize(s0, seed, &kEmpty, 0);
+  }
   const char* p = static_cast<const char*>(buf);
   Xxh64State s(seed);
   const size_t consumed = xxh_consume_stripes(s, p, n);

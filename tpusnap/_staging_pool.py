@@ -71,13 +71,16 @@ def acquire(nbytes: int) -> np.ndarray:
 
 
 def release(buf) -> bool:
-    """Return a buffer to the pool; True when the pool RETAINED it (the
-    memory stays resident — callers doing budget accounting must not
-    credit those bytes back). Ignores buffers the pool did not hand out
-    (memoryviews of user state, slabs, ...). When the cap is exceeded
-    the OLDEST free entries are evicted first, so a process whose
-    staged sizes change (model resize, different snapshot contents)
-    ages the stale sizes out instead of stranding them forever."""
+    """Return a buffer to the pool; True when the pool RETAINED it.
+    Retained bytes are bounded by TPUSNAP_STAGING_POOL_BYTES, a cache
+    budget of its own — the write scheduler's memory budget governs
+    in-flight staging buffers only and credits every write back in
+    full (see execute_write_reqs). Ignores buffers the pool did not
+    hand out (memoryviews of user state, slabs, ...). When the cap is
+    exceeded the OLDEST free entries are evicted first, so a process
+    whose staged sizes change (model resize, different snapshot
+    contents) ages the stale sizes out instead of stranding them
+    forever."""
     global _free_bytes
     if not isinstance(buf, np.ndarray):
         return False
@@ -94,6 +97,13 @@ def release(buf) -> bool:
         _free.append((buf.nbytes, buf))
         _free_bytes += buf.nbytes
         return True
+
+
+def free_bytes() -> int:
+    """Bytes currently RESIDENT in the free list (bounded by
+    TPUSNAP_STAGING_POOL_BYTES)."""
+    with _lock:
+        return _free_bytes
 
 
 def clear() -> None:

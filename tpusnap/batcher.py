@@ -209,10 +209,10 @@ class DeviceBatchedBufferStager(BufferStager):
                 keep_stagers.append(stager)
                 continue
             mv = memoryview(host[offset : offset + nbytes])
+            dedup = getattr(stager, "dedup_entry", None)
             _record_checksums(
                 stager.entry, mv, getattr(stager, "record_dedup_hashes", False)
             )
-            dedup = getattr(stager, "dedup_entry", None)
             if dedup is not None and dedup_entries_match(stager.entry, dedup):
                 stager.entry.location = dedup.location
                 stager.entry.byte_range = (

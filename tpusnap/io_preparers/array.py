@@ -148,21 +148,18 @@ class ArrayBufferStager(BufferStager):
         if want_crc and self.dedup_entry is not None:
             # Incremental dedup: hash first (the expected outcome is
             # "unchanged", where no clone and no write happen at all).
-            # TILED blobs run the CRC-only pass here (whole-blob dedup
-            # on multiple independent tile CRCs needs no second hash)
-            # and pay the 64-bit tile-hash lane ONLY when they actually
-            # changed — an unchanged-state incremental take stays one
-            # hardware-CRC pass. Tile-less blobs need their dedup_hash
-            # as part of the match evidence, so they hash both up front
-            # (they are small or rare shapes).
+            # A skip decision needs MORE than 32 bits of evidence per
+            # unit of skipped data (ADVICE r4: tile CRCs alone leave a
+            # single-CRC channel when the change is confined to one
+            # tile), so the 64-bit lane rides the SAME fused memory
+            # pass as the CRCs: record_dedup_hashes is always True when
+            # dedup_entry is set (incremental takes force it,
+            # snapshot.py), which is what arms the 64-bit side of the
+            # match below. A base without recorded hashes
+            # conservatively rewrites (dedup_entries_match).
             from ..io_types import SKIP_WRITE
 
-            tile_rows, _ = _tile_geometry(self.entry, mv.nbytes)
-            _record_checksums(
-                self.entry,
-                mv,
-                self.record_dedup_hashes and not tile_rows,
-            )
+            _record_checksums(self.entry, mv, self.record_dedup_hashes)
             if dedup_entries_match(self.entry, self.dedup_entry):
                 self.entry.location = self.dedup_entry.location
                 self.entry.byte_range = (
@@ -170,40 +167,10 @@ class ArrayBufferStager(BufferStager):
                     if self.dedup_entry.byte_range is not None
                     else None
                 )
-                # Same bytes as the base blob: its recorded 64-bit
-                # hashes describe this entry too — adopt them so the
-                # NEXT increment can still make tile-grain decisions.
-                if self.entry.tile_checksums and self.dedup_entry.tile_dedup_hashes:
-                    self.entry.tile_dedup_hashes = list(
-                        self.dedup_entry.tile_dedup_hashes
-                    )
-                if self.entry.dedup_hash is None:
-                    self.entry.dedup_hash = self.dedup_entry.dedup_hash
                 return SKIP_WRITE
             clone = self.is_async_snapshot and _may_alias_live_memory(
                 self.arr, host
             )
-            if clone and self.record_dedup_hashes and tile_rows:
-                # Changed tiled blob on the async path: the defensive
-                # clone and the deferred tile-hash lane fuse into ONE
-                # memory pass (the CRCs recomputed alongside are the
-                # values already recorded).
-                from .. import _native
-
-                out = _acquire_clone_buffer(mv.nbytes)
-                _, row_nbytes = _tile_geometry(self.entry, mv.nbytes)
-                _, xxhs = _native.memcpy_crc_xxh_tiles(
-                    out, mv, tile_rows * row_nbytes
-                )
-                dalgo = _native.dedup_hash_algorithm()
-                self.entry.tile_dedup_hashes = [
-                    f"{dalgo}:{x & _XXH_MASK:016x}" for x in xxhs
-                ]
-                return out
-            if self.record_dedup_hashes and tile_rows:
-                # Changed tiled blob: record the tile-hash lane now (it
-                # is about to be written at disk speed anyway).
-                _record_tile_dedup_hashes(self.entry, mv)
             if clone:
                 from .. import _native
 
@@ -383,13 +350,16 @@ def dedup_entries_match(new: TensorEntry, prev: TensorEntry) -> bool:
     same tile-grain CRCs (a changed tile-size knob between takes makes
     geometries differ and conservatively fails the match).
 
-    Equality needs MORE than one 32-bit CRC (ADVICE r3: a changed blob
-    whose CRC collides with the base's silently restores stale data, a
-    ~2^-32 channel per blob-take at fleet scale): tiled blobs carry
-    multiple independent tile CRCs, and tile-less blobs must carry a
-    matching 64-bit ``dedup_hash`` on BOTH sides — a base without one
-    (older format, or a blob above the eager-hash size) conservatively
-    rewrites."""
+    Equality needs MORE than one 32-bit CRC of evidence per unit of
+    skipped data (ADVICE r3/r4: a changed blob whose CRC collides with
+    the base's silently restores stale data, a ~2^-32 channel per
+    blob-take at fleet scale — and a change confined to ONE tile rests
+    on that tile's single CRC, however many unchanged tiles also
+    match): tiled blobs must carry matching 64-bit per-tile
+    ``tile_dedup_hashes`` on BOTH sides, and tile-less blobs a matching
+    64-bit ``dedup_hash`` on BOTH sides — a base without the hashes
+    (older format, non-incremental take, or a blob above the eager-hash
+    size) conservatively rewrites."""
     if not (
         prev.checksum is not None
         and new.checksum == prev.checksum
@@ -401,11 +371,11 @@ def dedup_entries_match(new: TensorEntry, prev: TensorEntry) -> bool:
     ):
         return False
     if new.tile_checksums:
-        # >= 2 independent 32-bit values already matched; the 64-bit tile
-        # hashes additionally bind when both sides recorded them.
-        if new.tile_dedup_hashes and prev.tile_dedup_hashes:
-            return new.tile_dedup_hashes == prev.tile_dedup_hashes
-        return True
+        return bool(
+            new.tile_dedup_hashes
+            and prev.tile_dedup_hashes
+            and new.tile_dedup_hashes == prev.tile_dedup_hashes
+        )
     return (
         new.dedup_hash is not None
         and prev.dedup_hash is not None
@@ -506,22 +476,6 @@ def _annotate_checksums(
 
 
 _XXH_MASK = (1 << 64) - 1
-
-
-def _record_tile_dedup_hashes(entry: TensorEntry, mv: memoryview) -> None:
-    """Record ONLY the per-tile 64-bit dedup hashes (CRCs already
-    recorded) — the deferred lane for changed blobs in incremental
-    takes."""
-    from .. import _native
-
-    tile_rows, row_nbytes = _tile_geometry(entry, mv.nbytes)
-    if not tile_rows:
-        return
-    _, xxhs = _native.crc_xxh_tiles(mv, tile_rows * row_nbytes)
-    dalgo = _native.dedup_hash_algorithm()
-    entry.tile_dedup_hashes = [
-        f"{dalgo}:{x & _XXH_MASK:016x}" for x in xxhs
-    ]
 
 
 def _record_checksums(
