@@ -417,10 +417,11 @@ def main() -> None:
         scrub_roofline = max(scrub_rooflines)
 
         # pinned_host (UVM analog) capability probe on the REAL backend,
-        # in a subprocess with a hard timeout — the PJRT tunnel to the
-        # chip is known to wedge for minutes, and a wedged probe must
-        # not take the bench down with it.
-        import subprocess
+        # via the wedge-proof runner (own process group, no inherited
+        # pipes, group SIGKILL on timeout, one retry) — round 4's
+        # subprocess.run(capture_output=...) version blocked draining
+        # pipes a surviving tunnel helper held open and lost the leg.
+        from tpusnap._subproc import run_hard_timeout
 
         probe_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
@@ -428,21 +429,59 @@ def main() -> None:
             "pinned_host",
             "probe.py",
         )
+        health_code = (
+            "import json, time, jax, numpy as np, jax.numpy as jnp\n"
+            "t0 = time.perf_counter()\n"
+            "d = jax.devices()[0]\n"
+            "np.asarray(jax.device_put(jnp.ones(1 << 16, jnp.float32), d))\n"
+            "print(json.dumps({'platform': d.platform,"
+            " 's': round(time.perf_counter() - t0, 2)}))\n"
+        )
         try:
-            r = subprocess.run(
-                [sys.executable, probe_path],
-                capture_output=True,
-                text=True,
-                timeout=300,
+            # Fast health gate first: a dead tunnel must cost the bench
+            # ~90s with the cause recorded, not 2x the full probe
+            # timeout. 45s per attempt covers cold PJRT init (measured
+            # 12.6s through the tunnel incl. jax startup); the retry
+            # keeps a healthy-but-cold backend from being falsely
+            # declared dead by one slow first attempt.
+            health = run_hard_timeout(
+                [sys.executable, "-c", health_code], timeout_s=45, retries=1
             )
-            lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
-            pinned_host = (
-                json.loads(lines[-1])
-                if lines
-                else {"ok": False, "error": f"rc={r.returncode}: {r.stderr[-200:]}"}
-            )
-        except subprocess.TimeoutExpired:
-            pinned_host = {"ok": False, "error": "timeout (TPU tunnel hang)"}
+            if health.timed_out or health.returncode != 0:
+                pinned_host = {
+                    "ok": False,
+                    "skipped": True,
+                    "error": (
+                        "tunnel unhealthy: 45s device-roundtrip probe "
+                        + (
+                            f"timed out ({health.attempts} attempts)"
+                            if health.timed_out
+                            else f"rc={health.returncode}: {health.stderr[-200:]}"
+                        )
+                    ),
+                }
+            else:
+                r = run_hard_timeout(
+                    [sys.executable, probe_path], timeout_s=150, retries=1
+                )
+                if r.timed_out:
+                    pinned_host = {
+                        "ok": False,
+                        "error": "timeout (TPU tunnel hang)",
+                        "attempts": r.attempts,
+                    }
+                else:
+                    lines = [
+                        ln for ln in r.stdout.strip().splitlines() if ln.strip()
+                    ]
+                    pinned_host = (
+                        json.loads(lines[-1])
+                        if lines
+                        else {
+                            "ok": False,
+                            "error": f"rc={r.returncode}: {r.stderr[-200:]}",
+                        }
+                    )
         except Exception as e:  # noqa: BLE001
             pinned_host = {"ok": False, "error": str(e)}
     finally:

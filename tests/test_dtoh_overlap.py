@@ -12,7 +12,6 @@ skips when no non-CPU device is reachable.
 
 import json
 import os
-import subprocess
 import sys
 
 import pytest
@@ -63,25 +62,27 @@ print(json.dumps({"ratio": best_ratio}))
 
 
 def test_copy_to_host_async_overlaps_transfers():
+    from tpusnap._subproc import run_hard_timeout
+
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # let the real backend register
     env.pop("XLA_FLAGS", None)
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PROBE],
-            capture_output=True,
-            text=True,
-            timeout=280,
-            env=env,
-        )
-    except subprocess.TimeoutExpired:
+    # run_hard_timeout, NOT subprocess.run(capture_output=...): the
+    # PJRT tunnel helper survives a child kill holding the captured
+    # pipes open, which wedged a full-suite run >60 min in round 4.
+    proc = run_hard_timeout(
+        [sys.executable, "-c", _PROBE], timeout_s=150, env=env, retries=1
+    )
+    if proc.timed_out:
         # The real-TPU tunnel can hang under contention; that's an
         # environment condition, not an overlap regression.
         pytest.skip("accelerator probe timed out (tunnel busy/unreachable)")
     if proc.returncode != 0:
         pytest.skip(f"accelerator probe failed: {proc.stderr[-500:]}")
-    line = proc.stdout.strip().splitlines()[-1]
-    result = json.loads(line)
+    lines = proc.stdout.strip().splitlines()
+    if not lines:
+        pytest.skip("accelerator probe produced no output")
+    result = json.loads(lines[-1])
     if "skip" in result:
         pytest.skip(result["skip"])
     # Pre-enqueued DMAs must beat serial request-then-wait transfers.
