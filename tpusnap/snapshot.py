@@ -746,7 +746,16 @@ def _take_impl(
         comm, local_world_size=local_world_size
     )
     pending_io_work = sync_execute_write_reqs(
-        write_reqs, storage, memory_budget, rank, event_loop
+        write_reqs,
+        storage,
+        memory_budget,
+        rank,
+        event_loop,
+        # Async takes: training is blocked until staging completes, so
+        # writes wait their turn (they drain in the background via
+        # PendingIOWork) instead of stealing CPU from the staging pass
+        # — see execute_write_reqs.
+        prioritize_staging=is_async_snapshot,
     )
     # The manifest is gathered AFTER staging completes (sync_execute
     # returns at staging-complete; storage I/O may still be in flight):
