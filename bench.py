@@ -75,13 +75,17 @@ the snapshot just taken — that belong to process startup, not the
 restore path (r03 measured an 11.9 s first run vs 2.0 s steady-state;
 the warmup makes that split explicit instead of folding it into min()).
 
-Memory accounting: ``take_peak_rss_mb`` is the peak RSS delta
-(rss_profiler, 100 ms sampling) over the best take run, and
-``memory_budget_gb`` the scheduler budget it ran under — the pair that
-validates the reference's signature "adapts to host RAM" property
-(reference benchmarks/load_tensor/main.py:39-44). Set
-TPUSNAP_BENCH_BYTES=21474836480 TPUSNAP_MAX_PER_RANK_MEMORY_BUDGET_BYTES
-to reproduce the published 20 GB / budget-capped row of BENCHMARKS.md.
+Memory accounting: ``async_take_peak_rss_mb`` is the peak RSS delta
+(rss_profiler, 100 ms sampling) over one async take at bench scale —
+the defensive-clone path, where RSS MUST move, so the field doubles as
+the sampler's self-check (the former sync-take take_peak_rss_mb was
+pinned at ~0 by zero-copy staging and carried no information) —
+alongside ``async_take_blocked_s`` (the staging-priority blocked
+window) and ``memory_budget_gb``, the scheduler budget the takes ran
+under — together the evidence for the reference's signature "adapts to
+host RAM" property (reference benchmarks/load_tensor/main.py:39-44).
+Set TPUSNAP_BENCH_BYTES to shrink the run below the default
+baseline-scale 20 GB.
 
 The state is **host-resident** (numpy): this benchmark measures the
 framework pipeline — zero-copy serialization, budget-gated scheduling,
@@ -108,7 +112,10 @@ import numpy as np
 # Reference: 20 GB / 13.91 s on 1×A100, local FS (BASELINE.md).
 BASELINE_GBPS = 20.0 / 13.91
 
-TOTAL_BYTES = int(os.environ.get("TPUSNAP_BENCH_BYTES", 2 * 1024**3))
+# Default = the baseline's own scale (20 GB, reference
+# benchmarks/ddp/README.md:17) so vs_baseline compares like with like;
+# TPUSNAP_BENCH_BYTES shrinks it for quick local runs.
+TOTAL_BYTES = int(os.environ.get("TPUSNAP_BENCH_BYTES", 20 * 1024**3))
 N_ARRAYS = 16
 N_TAKE_RUNS = int(os.environ.get("TPUSNAP_BENCH_RUNS", 4))
 
@@ -310,7 +317,6 @@ def main() -> None:
         times = []
         splits = []
         rooflines = []
-        rss_peaks = []
         budget_bytes = None
         for run in range(N_TAKE_RUNS):
             rooflines.append(
@@ -321,12 +327,9 @@ def main() -> None:
             # Drain pending page-cache writeback from earlier iterations so
             # each timed take competes only with its own I/O.
             os.sync()
-            rss_deltas: list = []
             t0 = time.perf_counter()
-            with measure_rss_deltas(rss_deltas):
-                Snapshot.take(os.path.join(tmp, "snap"), app_state)
+            Snapshot.take(os.path.join(tmp, "snap"), app_state)
             times.append(time.perf_counter() - t0)
-            rss_peaks.append(max(rss_deltas, default=0))
             stats = _sched.LAST_EXECUTION_STATS.get("write", {})
             budget_bytes = stats.get("budget_bytes") or budget_bytes
             splits.append(
@@ -339,7 +342,28 @@ def main() -> None:
         gbps = nbytes / best / 1e9
         staging_s, sched_total_s = splits[best_i]
         roofline = max(rooflines)
-        take_peak_rss = rss_peaks[best_i]
+
+        # Async-take leg at bench scale: the blocked window (under
+        # staging-priority scheduling this is the defensive-clone pass)
+        # and its peak RSS. This replaces the former sync-take
+        # take_peak_rss_mb, which was pinned at ~0 by design (sync
+        # takes of numpy state stage zero-copy views) and therefore
+        # indistinguishable from a broken sampler — the async clone
+        # path is the configuration where RSS MUST move, so the field
+        # doubles as the sampler's self-check.
+        async_dir = os.path.join(bench_root, "async_take", "snap")
+        os.sync()
+        rss_deltas = []
+        t0 = time.perf_counter()
+        with measure_rss_deltas(rss_deltas):
+            pending = Snapshot.async_take(
+                async_dir, {"model": PytreeState(state)}
+            )
+            async_blocked_s = time.perf_counter() - t0
+            pending.wait()
+        async_total_s = time.perf_counter() - t0
+        async_peak_rss = max(rss_deltas, default=0)
+        shutil.rmtree(os.path.dirname(async_dir), ignore_errors=True)
 
         # Beyond-reference capabilities, measured on the last snapshot:
         # an incremental take of the UNCHANGED state (all blobs dedup —
@@ -350,12 +374,25 @@ def main() -> None:
         last_snap = os.path.join(
             bench_root, f"take{N_TAKE_RUNS - 1}", "snap"
         )
+        # The incremental base records 64-bit dedup hashes
+        # (TPUSNAP_RECORD_DEDUP_HASHES — the documented pattern for
+        # bases of planned chains): skip decisions need 64-bit evidence
+        # on both sides, and a plain base conservatively rewrites once.
+        # Taken untimed so the headline take samples stay hash-lane-free.
+        from tpusnap.knobs import override_record_dedup_hashes
+
+        inc_base = os.path.join(bench_root, "inc_base", "snap")
+        with override_record_dedup_hashes(True):
+            Snapshot.take(inc_base, {"model": PytreeState(state)})
+        os.sync()
         inc_path = os.path.join(bench_root, "inc", "snap")
         t0 = time.perf_counter()
         Snapshot.take(
-            inc_path, {"model": PytreeState(state)}, incremental_from=last_snap
+            inc_path, {"model": PytreeState(state)}, incremental_from=inc_base
         )
         inc_take_s = time.perf_counter() - t0
+        shutil.rmtree(os.path.join(bench_root, "inc_base"), ignore_errors=True)
+        shutil.rmtree(os.path.join(bench_root, "inc"), ignore_errors=True)
 
         # Scrub, interleaved with its own roofline: the exact byte ranges
         # the scrub verifies, read through the same native fused read+CRC
@@ -535,7 +572,13 @@ def main() -> None:
                 "restore_warmup_s": round(restore_warmup_s, 2),
                 "restore_cold_cache": cold,
                 "restore_verified": ok,
-                "take_peak_rss_mb": round(take_peak_rss / 1e6),
+                "async_take_blocked_s": round(async_blocked_s, 2),
+                "async_take_total_s": round(async_total_s, 2),
+                # Clone-path RSS: must be >> 0 (the defensive clones are
+                # real allocations) — doubles as the RSS sampler's
+                # self-check, unlike the sync take whose zero-copy
+                # staging pinned the old take_peak_rss_mb at 0.
+                "async_take_peak_rss_mb": round(async_peak_rss / 1e6),
                 "memory_budget_gb": (
                     round(budget_bytes / 1e9, 2) if budget_bytes else None
                 ),

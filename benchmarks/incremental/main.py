@@ -40,11 +40,18 @@ def main() -> None:
     parser.add_argument("--gb", type=float, default=2.0)
     parser.add_argument("--hot-mb", type=float, default=64.0)
     parser.add_argument("--runs", type=int, default=3)
+    parser.add_argument(
+        "--chain-depth",
+        type=int,
+        default=100,
+        help="depth of the incremental-chain sweep (0 disables)",
+    )
     args = parser.parse_args()
 
     import numpy as np
 
     from tpusnap import Snapshot, StateDict, verify_snapshot
+    from tpusnap.knobs import override_record_dedup_hashes
 
     frozen_nbytes = int(args.gb * 1024**3)
     hot_nbytes = int(args.hot_mb * 1024**2)
@@ -67,7 +74,13 @@ def main() -> None:
             inc = os.path.join(root, f"inc{run}")
             state = {"app": StateDict(frozen=frozen, hot=hot)}
             t0 = time.perf_counter()
-            Snapshot.take(base, state)
+            # Bases of planned incremental chains record 64-bit dedup
+            # hashes (TPUSNAP_RECORD_DEDUP_HASHES — the documented
+            # production pattern): every skip decision then has 64-bit
+            # evidence from the FIRST increment. A plain base
+            # conservatively rewrites once instead.
+            with override_record_dedup_hashes(True):
+                Snapshot.take(base, state)
             full_times.append(time.perf_counter() - t0)
 
             hot2 = hot + np.float32(run + 1)
@@ -110,6 +123,75 @@ def main() -> None:
             f"scrub (verify):   {t_scrub:.2f}s ({total_gb / t_scrub:.2f} GB/s) "
             f"runs={[round(t, 2) for t in scrub_times]}"
         )
+
+        # Chain-depth sweep: the production resume loop is a LONG chain
+        # of increments. Chains collapse to the oldest base, so the
+        # numbers to watch at depth are flat-ness: manifest size, take
+        # time, and tip-restore latency must NOT grow with depth.
+        if args.chain_depth:
+            chain_root = os.path.join(root, "chain")
+            os.makedirs(chain_root)
+            hot_c = hot.copy()
+            prev = os.path.join(chain_root, "d0000")
+            with override_record_dedup_hashes(True):
+                Snapshot.take(
+                    prev, {"app": StateDict(frozen=frozen, hot=hot_c)}
+                )
+            checkpoints = sorted(
+                {1, 10, 25, 50, args.chain_depth} | set()
+            )
+            rows = []
+            take_window = []
+            for d in range(1, args.chain_depth + 1):
+                hot_c = hot_c + np.float32(1)
+                path = os.path.join(chain_root, f"d{d:04d}")
+                t0 = time.perf_counter()
+                Snapshot.take(
+                    path,
+                    {"app": StateDict(frozen=frozen, hot=hot_c)},
+                    incremental_from=prev,
+                )
+                take_window.append(time.perf_counter() - t0)
+                prev = path
+                if d in checkpoints:
+                    meta = os.path.getsize(
+                        os.path.join(path, ".snapshot_metadata")
+                    )
+                    target = {
+                        "app": StateDict(
+                            frozen=np.empty_like(frozen),
+                            hot=np.empty_like(hot_c),
+                        )
+                    }
+                    t0 = time.perf_counter()
+                    Snapshot(path).restore(target)
+                    t_restore = time.perf_counter() - t0
+                    # Verify BOTH leaves: "hot" is the freshly written
+                    # blob, "frozen" is the data that resolved through
+                    # the collapsed dedup chain — the path this sweep
+                    # exists to exercise.
+                    assert np.array_equal(target["app"]["hot"], hot_c)
+                    assert np.array_equal(target["app"]["frozen"], frozen)
+                    rows.append(
+                        (d, meta, min(take_window[-10:]), t_restore)
+                    )
+            print("chain depth sweep (take = min of last 10):")
+            for d, meta, t_take, t_restore in rows:
+                print(
+                    f"  depth {d:4d}: manifest {meta / 1e3:6.1f} KB, "
+                    f"take {t_take:5.2f}s, tip restore {t_restore:5.2f}s"
+                )
+            # Compare deep vs the depth-10 row: both are min-of-10
+            # samples (depth 1 is a single sample that also carries the
+            # chain's one-time warmup, so a ratio against it is biased).
+            shallow = rows[1] if len(rows) > 1 else rows[0]
+            deep = rows[-1]
+            print(
+                f"  depth {deep[0]} vs {shallow[0]}: "
+                f"manifest {deep[1] / shallow[1]:.2f}x, "
+                f"take {deep[2] / shallow[2]:.2f}x, "
+                f"restore {deep[3] / shallow[3]:.2f}x (flat = 1.0x)"
+            )
     finally:
         shutil.rmtree(root, ignore_errors=True)
 

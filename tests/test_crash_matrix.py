@@ -1,0 +1,215 @@
+"""Randomized crash-matrix soak for the two-phase commit protocol.
+
+``test_crash.py`` kills one take mid-write; this matrix SIGKILLs a
+take inside each distinct phase of the commit protocol, across seeded
+jitter within each window, and asserts the ONE invariant the protocol
+promises after every kill (reference invariant: metadata written last,
+snapshot invisible until then — torchsnapshot snapshot.py commit
+ordering):
+
+    .snapshot_metadata exists  ⟺  the snapshot restores bit-exact
+                                   (and scrubs clean)
+
+Windows:
+- ``staging``      — inside the staging pass (blob files partial);
+- ``residual_io``  — after async_take returned, residual storage I/O
+                     still draining in the background thread;
+- ``metadata``     — inside the metadata writer, after a PARTIAL
+                     temp-file write has been flushed to disk (the
+                     temp+rename atomicity window);
+- ``durable``      — TPUSNAP_DURABLE_COMMIT=1, inside the pre-barrier
+                     durable flush of created dirents.
+
+Each (window, seed) run jitters the kill delay within the window, so
+kills land at varied instants — including occasionally AFTER the
+window completes, which exercises the other side of the ⟺ (metadata
+present must imply a perfect restore). The child builds a
+deterministic state from the seed so the parent can verify
+bit-exactness independently.
+"""
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tpusnap import Snapshot, StateDict, verify_snapshot
+
+_N_ARRAYS = 12
+_ARR_SHAPE = (256, 256)  # ~256 KB each -> ~3 MB state, many blobs
+
+
+def _expected_state(seed: int):
+    return {
+        f"w{i}": np.random.default_rng(seed * 1000 + i)
+        .standard_normal(_ARR_SHAPE)
+        .astype(np.float32)
+        for i in range(_N_ARRAYS)
+    }
+
+
+_CHILD = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+window, path, seed = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+_WINDOW_SLEEP = 1.2
+
+def mark_and_linger():
+    # The parent SIGKILLs at a seeded delay within this sleep; if the
+    # jitter overshoots, execution proceeds and the take COMPLETES —
+    # exercising the "metadata present => restores bit-exact" side.
+    print("MARK", flush=True)
+    time.sleep(_WINDOW_SLEEP)
+
+import tpusnap.snapshot as snap_mod
+import tpusnap.storage_plugins.fs as fs_mod
+from tpusnap import Snapshot, StateDict
+
+if window == "staging":
+    from tpusnap.io_preparers import array as arr_mod
+    orig_stage = arr_mod.ArrayBufferStager._stage_blocking
+    fired = [False]
+    def hooked(self):
+        if not fired[0]:
+            fired[0] = True
+            mark_and_linger()
+        return orig_stage(self)
+    arr_mod.ArrayBufferStager._stage_blocking = hooked
+elif window == "residual_io":
+    # Slow every write so plenty of residual I/O is pending when
+    # async_take returns.
+    orig_write = fs_mod.FSStoragePlugin.write
+    async def slow_write(self, write_io):
+        import asyncio
+        await asyncio.sleep(0.08)
+        await orig_write(self, write_io)
+    fs_mod.FSStoragePlugin.write = slow_write
+elif window == "metadata":
+    orig_meta = snap_mod._write_metadata
+    def hooked_meta(storage, metadata, event_loop):
+        # A partial, FLUSHED temp write first: the crash window the
+        # temp+rename protocol exists for.
+        tmp = os.path.join(path, ".snapshot_metadata.crashtmp")
+        with open(tmp, "wb") as f:
+            f.write(b"{" + b"x" * 100)
+            f.flush()
+            os.fsync(f.fileno())
+        mark_and_linger()
+        os.unlink(tmp)
+        return orig_meta(storage, metadata, event_loop)
+    snap_mod._write_metadata = hooked_meta
+elif window == "durable":
+    os.environ["TPUSNAP_DURABLE_COMMIT"] = "1"
+    orig_flush = fs_mod.FSStoragePlugin.sync_flush_created_dirs
+    def hooked_flush(self, event_loop):
+        mark_and_linger()
+        return orig_flush(self, event_loop)
+    fs_mod.FSStoragePlugin.sync_flush_created_dirs = hooked_flush
+else:
+    raise SystemExit(f"unknown window {window}")
+
+state = {
+    f"w{i}": np.random.default_rng(seed * 1000 + i)
+    .standard_normal((256, 256))
+    .astype(np.float32)
+    for i in range(12)
+}
+os.environ["TPUSNAP_DISABLE_BATCHING"] = "1"
+
+if window == "residual_io":
+    pending = Snapshot.async_take(path, {"app": StateDict(**state)})
+    mark_and_linger()
+    pending.wait()
+else:
+    Snapshot.take(path, {"app": StateDict(**state)})
+print("DONE", flush=True)
+"""
+
+
+def _run_window(tmp_path, window: str, seed: int) -> None:
+    import select
+
+    path = str(tmp_path / "snap")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD, window, path, str(seed)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        # Wait for the child to enter the window — via select, so a
+        # wedged-silent child hits the deadline instead of blocking
+        # readline() forever (the pipe-wedge class _subproc.py exists
+        # for).
+        buf = ""
+        deadline = time.monotonic() + 120
+        marked = eof = False
+        while time.monotonic() < deadline and not marked and not eof:
+            ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+            if not ready:
+                continue
+            chunk = os.read(proc.stdout.fileno(), 4096).decode(
+                "utf-8", errors="replace"
+            )
+            if chunk == "":
+                eof = True
+                break
+            buf += chunk
+            marked = "MARK" in buf
+        if not marked:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=60)
+            pytest.fail(
+                f"child never reached window {window!r} "
+                f"(eof={eof}): {buf[-2000:]}"
+            )
+        # Seeded jitter: kills land at varied instants inside (and
+        # occasionally after) the window.
+        time.sleep(random.Random(seed).uniform(0.0, 1.5))
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+
+    meta_path = os.path.join(path, ".snapshot_metadata")
+    if os.path.exists(meta_path):
+        # Committed ⟹ must be a complete, bit-exact, clean snapshot.
+        expected = _expected_state(seed)
+        target = {
+            "app": StateDict(
+                **{k: np.zeros(_ARR_SHAPE, np.float32) for k in expected}
+            )
+        }
+        Snapshot(path).restore(target)
+        for k, v in expected.items():
+            assert np.array_equal(target["app"][k], v), (window, seed, k)
+        assert verify_snapshot(path).clean, (window, seed)
+    else:
+        # Not committed ⟹ invisible.
+        with pytest.raises(RuntimeError, match="not a snapshot"):
+            Snapshot(path).metadata
+
+
+@pytest.mark.soak
+@pytest.mark.parametrize("window", ["staging", "residual_io", "metadata", "durable"])
+@pytest.mark.parametrize("seed", range(20))
+def test_crash_matrix(tmp_path, window, seed):
+    _run_window(tmp_path, window, seed)

@@ -666,3 +666,83 @@ def test_durable_commit_world2():
         run_subprocess_world(
             _world_durable_commit, world_size=2, args=[f"{d}/snap"]
         )
+
+
+def _world_multihost_budget(snap_dir):
+    """4 ranks across 2 simulated hosts: the per-host memory-budget
+    divisor must see local_world_size == 2 (ranks sharing MY node), and
+    the write-load partitioner must keep spreading replicated entries
+    across ALL ranks regardless of host boundaries (reference
+    benchmarks/ddp/README.md scales 1x8 -> 4x8 across nodes; spread is
+    per-rank there too)."""
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict
+    from tpusnap import scheduler as sched
+    from tpusnap.comm import get_communicator
+
+    from tpusnap.knobs import override_batching_disabled
+
+    comm = get_communicator()
+    state = StateDict(
+        **{
+            f"w{i}": np.arange(256 * 64, dtype=np.float32).reshape(256, 64)
+            + i
+            for i in range(8)
+        }
+    )
+    with override_batching_disabled(True):
+        Snapshot.take(snap_dir, {"model": state}, replicated=["**"])
+
+    # G1's hostname gather threaded the simulated topology into the
+    # budget divisor: 2 ranks per simulated host.
+    assert sched._cached_local_world_size == 2, (
+        comm.rank,
+        sched._cached_local_world_size,
+    )
+
+    if comm.rank == 0:
+        # Every replicated blob exists exactly once.
+        files = os.listdir(os.path.join(snap_dir, "replicated", "model"))
+        assert len(files) == 8, files
+        # The partitioner's assignment is HOST-AGNOSTIC: fed the same
+        # per-rank unit estimates take gathered, it spreads the 8 equal
+        # units across ranks on BOTH simulated hosts.
+        from tpusnap.partitioner import (
+            assign_replicated_units,
+            estimate_write_loads,
+        )
+
+        flattened = {
+            f"model/w{i}": state[f"w{i}"] for i in range(8)
+        }
+        units, base_load, _ = estimate_write_loads(
+            flattened, sorted(flattened)
+        )
+        assignment, _ = assign_replicated_units(
+            [units] * 4, [base_load] * 4
+        )
+        writer_ranks = set(assignment.values())
+        assert len(writer_ranks) >= 2, assignment
+        assert writer_ranks & {0, 1} and writer_ranks & {2, 3}, assignment
+    # Restore round-trips under the same simulated topology.
+    target = {"model": StateDict(**{f"w{i}": np.zeros((256, 64), np.float32) for i in range(8)})}
+    Snapshot(snap_dir).restore(target)
+    for i in range(8):
+        assert np.array_equal(
+            target["model"][f"w{i}"],
+            np.arange(256 * 64, dtype=np.float32).reshape(256, 64) + i,
+        )
+
+
+def test_multihost_simulated_budget_divisor():
+    """VERDICT r4 #5: 4 ranks / 2 simulated hosts — the memory-budget
+    divisor runs with local_world_size == 2 derived from heterogeneous
+    node names, and the partitioner spread is unchanged."""
+    with tempfile.TemporaryDirectory() as d:
+        run_subprocess_world(
+            _world_multihost_budget,
+            world_size=4,
+            args=[f"{d}/snap"],
+            hostnames=["hostA", "hostA", "hostB", "hostB"],
+        )

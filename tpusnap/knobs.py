@@ -160,6 +160,20 @@ def get_memory_budget_override_bytes() -> Optional[int]:
     return val if val > 0 else None
 
 
+_NODE_NAME_ENV_VAR = "TPUSNAP_NODE_NAME"
+
+
+def get_node_name() -> str:
+    """The identity used to decide which ranks SHARE A HOST (the
+    per-host memory-budget divisor gathers these). Defaults to the OS
+    hostname; ``TPUSNAP_NODE_NAME`` overrides it for containerized
+    jobs where every pod reports a unique hostname despite sharing a
+    node (kubernetes), and for multi-host simulation in tests."""
+    import socket
+
+    return os.environ.get(_NODE_NAME_ENV_VAR) or socket.gethostname()
+
+
 @contextlib.contextmanager
 def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
     prev = os.environ.get(name)

@@ -76,8 +76,10 @@ def get_process_memory_budget_bytes(
     elif _cached_local_world_size is not None:
         local_world_size = _cached_local_world_size
     elif comm is not None and comm.world_size > 1:
-        hostnames = comm.all_gather_object(socket.gethostname())
-        local_world_size = hostnames.count(socket.gethostname())
+        from .knobs import get_node_name
+
+        hostnames = comm.all_gather_object(get_node_name())
+        local_world_size = hostnames.count(get_node_name())
         _cached_local_world_size = local_world_size
     else:
         local_world_size = 1

@@ -594,20 +594,20 @@ def _take_impl(
     assignment: Dict[str, int] = {}
     local_world_size: Optional[int] = None
     if multi:
-        import socket
-
         from .partitioner import assign_replicated_units, estimate_write_loads
 
         units, base_load, traced_map = estimate_write_loads(
             flattened_all, sorted(matched), array_prepare_func=array_prepare_func
         )
+        from .knobs import get_node_name
+
         gathered = comm.all_gather_object(
             {
                 "path": path,
                 "globs": globs,
                 "units": units,
                 "base_load": base_load,
-                "hostname": socket.gethostname(),
+                "hostname": get_node_name(),
             }
         )
         # Path coalescing: rank 0's wins (reference :766-767).

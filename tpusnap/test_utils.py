@@ -113,9 +113,16 @@ def run_subprocess_world(
     timeout: float = 180.0,
     extra_env: Optional[Dict[str, str]] = None,
     args: Optional[List[str]] = None,
+    hostnames: Optional[List[str]] = None,
 ) -> List[str]:
     """Run ``fn`` in ``world_size`` jax.distributed-initialized processes.
-    Returns each rank's stdout; raises with full logs if any rank fails."""
+    Returns each rank's stdout; raises with full logs if any rank fails.
+
+    ``hostnames`` simulates a MULTI-HOST topology on one machine: rank i
+    runs with ``TPUSNAP_NODE_NAME=hostnames[i]``, which the per-host
+    memory-budget divisor and take's G1 hostname gather read in place of
+    the OS hostname — the closest honest approximation of the
+    reference's multi-node scaling available without real nodes."""
     port = find_free_port()
     coordinator = f"127.0.0.1:{port}"
     procs = []
@@ -147,6 +154,8 @@ def run_subprocess_world(
                 "TPUSNAP_TEST_MODULE_DIR": module_dir,
             }
         )
+        if hostnames is not None:
+            env["TPUSNAP_NODE_NAME"] = hostnames[rank]
         if extra_env:
             env.update(extra_env)
         procs.append(
