@@ -24,32 +24,22 @@ result is interpretable on any disk:
   on the pipeline.
 - ``staging_s`` / ``residual_io_s``: the scheduler's split of the best
   take (staging = the window training would be blocked in async_take).
-- ``restore_gbps``: cold-cache restore throughput of the same snapshot,
-  with a cold-read roofline sampled INTERLEAVED (same native read
-  engine + 8-stream pool reading the snapshot's own blobs):
-  - ``restore_roofline_gbps``: engine reads into FRESH unaligned numpy
-    buffers — what any checkpoint reader delivering bytes into
-    user-owned memory must do, including the ~2 GB of page faults. The
-    like-for-like ceiling; ``restore_roofline_fraction`` is restore
-    against this.
-  - ``restore_roofline_prefaulted_gbps``: same reads into pre-faulted
-    reused buffers — the disk-only ceiling with zero memory-management
-    cost. The spread between the two rooflines is page-fault cost, not
-    pipeline waste.
-  - ``restore_roofline_verified_gbps``: prefaulted reads WITH the fused
-    integrity CRC — the work a verifying restore cannot skip, so
-    ``restore_roofline_verified_fraction`` is the honest pipeline
-    efficiency; the prefaulted-minus-verified spread is pure checksum
-    cost (one fused pass, ~5 GB/s on this host's single core).
-  - ``restore_warm_gbps``: restore into already-faulted targets — the
-    PRODUCTION case (a resume loop restores into existing training
-    state). ``restore_gbps`` uses brand-new cold buffers, the worst
-    case: at high memory commit the kernel's fresh-anon-page zeroing
-    collapses (raw engine 0.18 GB/s at 20 GB here), an artifact of the
-    fresh-buffer benchmark shape, not of the restore pipeline.
+- ``restore_gbps`` / ``restore_warm_gbps``: full-scale ABSOLUTES —
+  fresh-target cold restores and warm-target (production resume-loop)
+  restores. No fractions are formed at full scale: a 20 GB sample
+  spans minutes and the virtio disk drifts several-fold within that,
+  so no two full-scale measurements share a window.
+- ``restore_verified_fraction`` — the pipeline-efficiency number,
+  from a tight-window ~2 GB probe where each paired sample takes
+  seconds: median over rounds of (warm-target restore) /
+  (prefaulted+CRC engine reads), both sides measured back-to-back in
+  one disk window, neither faulting pages, both checksumming every
+  byte. The remaining gap is genuinely the pipeline's. (A
+  fresh-target/fresh-buffer "cold" pair was tried and dropped —
+  fresh-anon page faulting interacts with drop_caches so erratically
+  that adjacent samples disagree 100x.)
   Restore reads land IN PLACE in the target arrays (native fused
-  read+checksum, no scratch buffer, no separate verify/copy passes), so
-  the verified restore tracks the fresh-destination roofline closely.
+  read+checksum, no scratch buffer, no separate verify/copy passes).
 
 - ``incremental_take_s`` / ``incremental_effective_gbps``: an
   ``incremental_from=`` take of the UNCHANGED state against the last
@@ -62,7 +52,8 @@ result is interpretable on any disk:
   read through the same native fused read+CRC engine at the same
   concurrency (TPUSNAP_SCRUB_CONCURRENCY slots, reused scratch), with
   zero manifest/asyncio machinery on top. ``scrub_roofline_fraction``
-  (best scrub / best roofline) is therefore pure pipeline efficiency;
+  (median of same-round scrub/roofline pairs) is therefore pure
+  pipeline efficiency;
   with per-run samples listed, a slow-disk window (this host swings
   >2x) shows up as BOTH numbers dropping while the fraction holds.
 
@@ -102,6 +93,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 import json
 import os
 import shutil
+import statistics
 import sys
 import tempfile
 import time
@@ -170,14 +162,27 @@ def main() -> None:
     from tpusnap import PytreeState, Snapshot
     from tpusnap import scheduler as _sched
 
+    from tpusnap import _native as _natalloc
+
     per_array = TOTAL_BYTES // N_ARRAYS
     rng = np.random.default_rng(0)
+    # DISTINCT resident buffers (the baseline checkpointed 20 GB of
+    # real state; overlapping views would shrink the source working
+    # set 16x and flatter every memory-bound pass), built at memcpy
+    # speed: one RNG pass generates per_array random u16s, and each
+    # array is that block rotated by i elements — pairwise-distinct
+    # bytes, no aligned identical blocks for host-side
+    # dedup/compression, ~20 s to build at 20 GB where np.roll+RNG per
+    # array took ~5 min (THP-advised destinations fault at ~2.4 GB/s
+    # vs ~0.17 for 4 KiB pages).
     raw = rng.integers(0, 2**16, per_array // 2, dtype=np.uint16)
-    state = {
-        # distinct buffers (shifted views copied) so no write dedups
-        f"w{i}": np.roll(raw, i).view(np.float16)
-        for i in range(N_ARRAYS)
-    }
+    state = {}
+    for i in range(N_ARRAYS):
+        dst = _natalloc.empty_advised((per_array // 2,), np.uint16)
+        dst[: per_array // 2 - i] = raw[i:]
+        if i:
+            dst[per_array // 2 - i :] = raw[:i]
+        state[f"w{i}"] = dst.view(np.float16)
     nbytes = sum(a.nbytes for a in state.values())
 
     bench_root = tempfile.mkdtemp(prefix="tpusnap_bench_")
@@ -196,40 +201,78 @@ def main() -> None:
 
         from tpusnap import _native as _nat
 
-        blob_files = [
-            f
-            for f in _glob.glob(os.path.join(restore_snap, "**", "*"), recursive=True)
-            if os.path.isfile(f) and not f.endswith(".snapshot_metadata")
-        ]
-        blob_sizes = {f: os.path.getsize(f) for f in blob_files}
-        prefaulted = {
-            f: np.empty(blob_sizes[f], dtype=np.uint8) for f in blob_files
-        }
-        for buf_ in prefaulted.values():
-            buf_[::4096] = 0  # fault every page once
+        def _paired_fraction_rounds(snap_path, pstate, rounds=5):
+            """Interleaved like-for-like fraction pairs over one
+            snapshot (VERDICT r4 #3: best-vs-best across disk windows
+            produced unbounded, uninformative fractions). Each round
+            measures, back to back in one disk window: prefaulted+CRC
+            engine reads, then a warm-target restore — neither faults
+            pages, both checksum every byte — whose ratio is
+            restore_verified_fraction, the pipeline-efficiency number.
+            (A fresh-target/fresh-buffer "cold" pair was tried and
+            dropped: fresh-anon page faulting interacts with
+            drop_caches so erratically that even adjacent samples
+            disagree 100x; the cold restore is reported as an ABSOLUTE
+            at full scale instead.) The median over rounds rides out a
+            single mid-pair disk stall. Also bit-verifies the last
+            warm restore against ``pstate``."""
+            files = [
+                f
+                for f in _glob.glob(
+                    os.path.join(snap_path, "**", "*"), recursive=True
+                )
+                if os.path.isfile(f) and not f.endswith(".snapshot_metadata")
+            ]
+            sizes = {f: os.path.getsize(f) for f in files}
+            total = sum(sizes.values())
+            pref = {f: np.empty(sizes[f], dtype=np.uint8) for f in files}
+            for buf_ in pref.values():
+                buf_[::4096] = 0  # fault every page once
 
-        def _engine_read_all(dests, want_crc: bool = False) -> float:
-            """Cold aggregate read of the snapshot's blobs through the
-            same native engine + 8-stream pool the restore uses.
-            ``want_crc=True`` fuses the integrity CRC into the reads —
-            the work a VERIFYING restore cannot skip, so the
-            prefaulted+CRC variant is the like-for-like ceiling for
-            ``restore_gbps`` (the plain variants isolate page-fault and
-            checksum cost instead)."""
-            _drop_caches()
+            def engine_read_all(dests, want_crc=False) -> float:
+                _drop_caches()
 
-            def read_one(f):
-                n = blob_sizes[f]
-                out = dests[f] if dests is not None else np.empty(n, np.uint8)
-                got, _, _ = _nat.read_range_into(f, 0, n, out, want_crc=want_crc)
-                assert got == n
+                def read_one(f):
+                    n = sizes[f]
+                    out = (
+                        dests[f] if dests is not None else np.empty(n, np.uint8)
+                    )
+                    got, _, _ = _nat.read_range_into(
+                        f, 0, n, out, want_crc=want_crc
+                    )
+                    assert got == n
 
-            ex = ThreadPoolExecutor(max_workers=8)
-            t0 = time.perf_counter()
-            list(ex.map(read_one, blob_files))
-            el = time.perf_counter() - t0
-            ex.shutdown()
-            return sum(blob_sizes.values()) / el / 1e9
+                ex = ThreadPoolExecutor(max_workers=8)
+                t0 = time.perf_counter()
+                list(ex.map(read_one, files))
+                el = time.perf_counter() - t0
+                ex.shutdown()
+                return total / el / 1e9
+
+            pbytes = sum(a.nbytes for a in pstate.values())
+            warm_t = {k: np.zeros_like(v) for k, v in pstate.items()}
+            out = {
+                "fracs_verified": [],
+                "rooflines_verified": [],
+                "warm_runs_s": [],
+            }
+            for _ in range(rounds):
+                rl_v = engine_read_all(pref, want_crc=True)
+                out["rooflines_verified"].append(rl_v)
+                _drop_caches()
+                t0 = time.perf_counter()
+                Snapshot(snap_path).restore({"model": PytreeState(warm_t)})
+                el = time.perf_counter() - t0
+                out["warm_runs_s"].append(el)
+                out["fracs_verified"].append((pbytes / el / 1e9) / rl_v)
+            ks = sorted(pstate)
+            out["verified_ok"] = all(
+                np.array_equal(
+                    warm_t[k].view(np.uint16), pstate[k].view(np.uint16)
+                )
+                for k in (ks[0], ks[-1])
+            )
+            return out
 
         # Untimed warmup restore: absorbs one-time costs (imports, native
         # lib load, allocator growth, residual host writeback of the
@@ -245,36 +288,21 @@ def main() -> None:
         )
         restore_warmup_s = time.perf_counter() - t0
 
-        # The disk's bandwidth swings >2x minute to minute, so roofline
-        # and restore are sampled interleaved (same reasoning as the
-        # write side below).
+        # Full-scale ABSOLUTES: warm-target (production resume-loop) and
+        # fresh-target cold restores. No engine rooflines here — at
+        # 20 GB a single sample spans minutes and the virtio disk
+        # drifts several-fold within that, so no two full-scale
+        # measurements share a window; fractions come from the tight
+        # 2 GB probe below instead.
         restore_runs = []
         restore_warm_runs = []
-        restore_rooflines = []
-        restore_rooflines_prefaulted = []
-        restore_rooflines_verified = []
-        # Warm-target restore destinations — the PRODUCTION case: a
-        # resume loop restores into long-lived existing training state
-        # whose pages are already faulted. Allocated ONCE and reused
-        # across runs, like real training state. (The fresh
-        # np.empty_like targets below are the worst case; at high
-        # memory commit the kernel's fresh-anon-page zeroing collapses
-        # — measured 0.18 GB/s raw-engine at 20 GB — an artifact of
-        # benchmarking into brand-new buffers, not of the pipeline.)
         warm_target = {
             f"w{i}": np.zeros_like(state[f"w{i}"]) for i in range(N_ARRAYS)
         }
-        for _ in range(3):
-            restore_rooflines.append(_engine_read_all(None))
-            restore_rooflines_prefaulted.append(_engine_read_all(prefaulted))
-            restore_rooflines_verified.append(
-                _engine_read_all(prefaulted, want_crc=True)
-            )
+        for _ in range(2):
             _drop_caches()
             t0 = time.perf_counter()
-            Snapshot(restore_snap).restore(
-                {"model": PytreeState(warm_target)}
-            )
+            Snapshot(restore_snap).restore({"model": PytreeState(warm_target)})
             restore_warm_runs.append(time.perf_counter() - t0)
             cold = _drop_caches()
             target = {
@@ -284,10 +312,8 @@ def main() -> None:
             t0 = time.perf_counter()
             Snapshot(restore_snap).restore(app_state)
             restore_runs.append(time.perf_counter() - t0)
-        del prefaulted
         restore_el = min(restore_runs)
         restore_gbps = nbytes / restore_el / 1e9
-        restore_roofline = max(restore_rooflines)
         # Bit-pattern comparison: random f16 buffers contain NaNs, and
         # NaN != NaN would fail a value comparison on correct data.
         ok = all(
@@ -297,8 +323,6 @@ def main() -> None:
             )
             for i in (0, N_ARRAYS - 1)
         ) and all(
-            # The warm-target (production-case) headline must be just as
-            # verified as the cold one.
             np.array_equal(
                 warm_target[f"w{i}"].view(np.uint16),
                 state[f"w{i}"].view(np.uint16),
@@ -307,6 +331,31 @@ def main() -> None:
         )
         del target, app_state, warm_target
         shutil.rmtree(os.path.join(bench_root, "restore_src"), ignore_errors=True)
+
+        # Tight-window FRACTION probe (~2 GB: every sample is seconds,
+        # so the paired samples genuinely share a disk window).
+        probe_bytes = min(TOTAL_BYTES, 2 * 1024**3)
+        probe_per = probe_bytes // N_ARRAYS
+        # Distinct-offset views into the random block (pairwise
+        # distinct bytes; the probe only feeds the fraction pairs, so
+        # the 16x-overlap source footprint is fine here); lengths
+        # equalized and offsets clamped so the smallest TOTAL_BYTES
+        # still fits.
+        probe_len = probe_per // 2 - N_ARRAYS
+        max_off = len(raw) - probe_len
+        step = max(1, min(997, max_off // max(N_ARRAYS - 1, 1)))
+        probe_state = {
+            f"w{i}": raw[i * step : i * step + probe_len].view(np.float16)
+            for i in range(N_ARRAYS)
+        }
+        probe_snap = os.path.join(bench_root, "fprobe", "snap")
+        Snapshot.take(probe_snap, {"model": PytreeState(probe_state)})
+        os.sync()
+        fr = _paired_fraction_rounds(probe_snap, probe_state, rounds=5)
+        ok = ok and fr["verified_ok"]
+        shutil.rmtree(os.path.join(bench_root, "fprobe"), ignore_errors=True)
+        restore_verified_fracs = fr["fracs_verified"]
+        restore_rooflines_verified = fr["rooflines_verified"]
 
         # The virtio disk's bandwidth swings >2x on multi-second timescales
         # (host contention), so roofline and take are sampled INTERLEAVED —
@@ -317,11 +366,11 @@ def main() -> None:
         times = []
         splits = []
         rooflines = []
+        take_fracs = []
         budget_bytes = None
         for run in range(N_TAKE_RUNS):
-            rooflines.append(
-                measure_roofline(bench_root, per_array, N_ARRAYS)
-            )
+            rl = measure_roofline(bench_root, per_array, N_ARRAYS)
+            rooflines.append(rl)
             tmp = os.path.join(bench_root, f"take{run}")
             app_state = {"model": PytreeState(state)}
             # Drain pending page-cache writeback from earlier iterations so
@@ -329,7 +378,12 @@ def main() -> None:
             os.sync()
             t0 = time.perf_counter()
             Snapshot.take(os.path.join(tmp, "snap"), app_state)
-            times.append(time.perf_counter() - t0)
+            el = time.perf_counter() - t0
+            times.append(el)
+            # Same-round pair: this take against the roofline sampled
+            # moments before it, so disk-bandwidth swings between
+            # rounds cancel out of the fraction.
+            take_fracs.append((nbytes / el / 1e9) / rl)
             stats = _sched.LAST_EXECUTION_STATS.get("write", {})
             budget_bytes = stats.get("budget_bytes") or budget_bytes
             splits.append(
@@ -351,19 +405,44 @@ def main() -> None:
         # indistinguishable from a broken sampler — the async clone
         # path is the configuration where RSS MUST move, so the field
         # doubles as the sampler's self-check.
-        async_dir = os.path.join(bench_root, "async_take", "snap")
-        os.sync()
-        rss_deltas = []
-        t0 = time.perf_counter()
-        with measure_rss_deltas(rss_deltas):
-            pending = Snapshot.async_take(
-                async_dir, {"model": PytreeState(state)}
-            )
-            async_blocked_s = time.perf_counter() - t0
-            pending.wait()
-        async_total_s = time.perf_counter() - t0
-        async_peak_rss = max(rss_deltas, default=0)
-        shutil.rmtree(os.path.dirname(async_dir), ignore_errors=True)
+        #
+        # Two takes: COLD (pool empty — every clone pays first-touch
+        # faulting) and WARM (the steady-state checkpoint loop: clones
+        # reuse the previous take's parked pages). The pool is sized to
+        # the state for the leg — the production guidance for async
+        # loops (the 4 GiB default would recycle only a fifth of a
+        # 20 GB clone set and keep every take mostly cold).
+        prev_pool = os.environ.get("TPUSNAP_STAGING_POOL_BYTES")
+        os.environ["TPUSNAP_STAGING_POOL_BYTES"] = str(nbytes + (1 << 28))
+        try:
+            async_blocked = []
+            async_total = []
+            rss_deltas = []
+            for run in range(2):
+                async_dir = os.path.join(
+                    bench_root, f"async_take{run}", "snap"
+                )
+                os.sync()
+                t0 = time.perf_counter()
+                with measure_rss_deltas(rss_deltas):
+                    pending = Snapshot.async_take(
+                        async_dir, {"model": PytreeState(state)}
+                    )
+                    async_blocked.append(time.perf_counter() - t0)
+                    pending.wait()
+                async_total.append(time.perf_counter() - t0)
+                shutil.rmtree(
+                    os.path.dirname(async_dir), ignore_errors=True
+                )
+            async_peak_rss = max(rss_deltas, default=0)
+        finally:
+            if prev_pool is None:
+                os.environ.pop("TPUSNAP_STAGING_POOL_BYTES", None)
+            else:
+                os.environ["TPUSNAP_STAGING_POOL_BYTES"] = prev_pool
+            from tpusnap import _staging_pool as _sp
+
+            _sp.clear()  # release the bench-sized pool
 
         # Beyond-reference capabilities, measured on the last snapshot:
         # an incremental take of the UNCHANGED state (all blobs dedup —
@@ -405,6 +484,11 @@ def main() -> None:
         from tpusnap.knobs import get_scrub_concurrency
 
         os.sync()
+        # Settle: the guest's sync returns before the HOST finishes
+        # absorbing the take section's writeback; cold reads in that
+        # window measure the host's flush, not the scrub (same reason
+        # the restore section runs first from a settled snapshot).
+        time.sleep(8.0)
         scrub_manifest = load_snapshot_metadata(last_snap).manifest
         scrub_ranges = []  # (abs_path, offset, nbytes)
         for b in iter_blobs(scrub_manifest):
@@ -442,13 +526,18 @@ def main() -> None:
 
         scrub_runs = []
         scrub_rooflines = []
+        scrub_fracs = []
         scrub_clean = True
         for _ in range(2):
-            scrub_rooflines.append(scrub_roofline_once())
+            rl = scrub_roofline_once()
+            scrub_rooflines.append(rl)
             _drop_caches()
             t0 = time.perf_counter()
             scrub_report = verify_snapshot(last_snap)
-            scrub_runs.append(time.perf_counter() - t0)
+            el = time.perf_counter() - t0
+            scrub_runs.append(el)
+            # Same-round pair (see the restore fractions).
+            scrub_fracs.append((scrub_bytes / el / 1e9) / rl)
             scrub_clean = scrub_clean and scrub_report.clean
         scrub_s = min(scrub_runs)
         scrub_roofline = max(scrub_rooflines)
@@ -532,7 +621,15 @@ def main() -> None:
                 "unit": "GB/s",
                 "vs_baseline": round(gbps / BASELINE_GBPS, 3),
                 "roofline_gbps": round(roofline, 3),
-                "roofline_fraction": round(gbps / roofline, 3),
+                # Median of same-round take/roofline pairs (disk swings
+                # cancel within a pair; best-vs-best across windows
+                # does not bound the value).
+                "roofline_fraction": round(
+                    statistics.median(take_fracs), 3
+                ),
+                "roofline_fraction_runs": [
+                    round(f, 3) for f in take_fracs
+                ],
                 "roofline_runs_gbps": [round(r, 3) for r in rooflines],
                 "take_runs_s": [round(t, 2) for t in times],
                 "staging_s": round(staging_s, 2) if staging_s else None,
@@ -542,26 +639,19 @@ def main() -> None:
                     else None
                 ),
                 "restore_gbps": round(restore_gbps, 3),
-                "restore_roofline_gbps": round(restore_roofline, 3),
-                "restore_roofline_fraction": round(
-                    restore_gbps / restore_roofline, 3
+                # Median of per-round like-for-like pairs from the
+                # tight-window probe: warm restore / prefaulted+CRC
+                # engine reads — neither side faults pages, both
+                # checksum every byte, both in one disk window.
+                "restore_verified_fraction": round(
+                    statistics.median(restore_verified_fracs), 3
                 ),
-                "restore_roofline_runs_gbps": [
-                    round(r, 3) for r in restore_rooflines
+                "restore_verified_fraction_runs": [
+                    round(f, 3) for f in restore_verified_fracs
                 ],
-                "restore_roofline_prefaulted_gbps": round(
-                    max(restore_rooflines_prefaulted), 3
-                ),
-                # Prefaulted + fused CRC: the ceiling a VERIFYING restore
-                # can actually reach; the fraction against it is the
-                # restore pipeline's efficiency net of page-fault and
-                # checksum cost (both isolated by the other rooflines).
-                "restore_roofline_verified_gbps": round(
-                    max(restore_rooflines_verified), 3
-                ),
-                "restore_roofline_verified_fraction": round(
-                    restore_gbps / max(restore_rooflines_verified), 3
-                ),
+                "restore_roofline_verified_runs_gbps": [
+                    round(r, 3) for r in restore_rooflines_verified
+                ],
                 "restore_runs_s": [round(t, 2) for t in restore_runs],
                 "restore_warm_gbps": round(
                     nbytes / min(restore_warm_runs) / 1e9, 3
@@ -572,8 +662,11 @@ def main() -> None:
                 "restore_warmup_s": round(restore_warmup_s, 2),
                 "restore_cold_cache": cold,
                 "restore_verified": ok,
-                "async_take_blocked_s": round(async_blocked_s, 2),
-                "async_take_total_s": round(async_total_s, 2),
+                # Warm = the steady-state checkpoint loop (pool pages
+                # reused); cold = first take of the process.
+                "async_take_blocked_s": round(async_blocked[-1], 2),
+                "async_take_blocked_cold_s": round(async_blocked[0], 2),
+                "async_take_total_s": round(async_total[-1], 2),
                 # Clone-path RSS: must be >> 0 (the defensive clones are
                 # real allocations) — doubles as the RSS sampler's
                 # self-check, unlike the sync take whose zero-copy
@@ -589,9 +682,13 @@ def main() -> None:
                 "scrub_s": round(scrub_s, 2),
                 "scrub_gbps": round(scrub_bytes / scrub_s / 1e9, 3),
                 "scrub_roofline_gbps": round(scrub_roofline, 3),
+                # Median of same-round pairs, like the restore fractions.
                 "scrub_roofline_fraction": round(
-                    (scrub_bytes / scrub_s / 1e9) / scrub_roofline, 3
+                    statistics.median(scrub_fracs), 3
                 ),
+                "scrub_roofline_fraction_runs": [
+                    round(f, 3) for f in scrub_fracs
+                ],
                 "scrub_runs_gbps": [
                     round(scrub_bytes / t / 1e9, 3) for t in scrub_runs
                 ],

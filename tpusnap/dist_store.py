@@ -36,6 +36,16 @@ class KVStore(abc.ABC):
     @abc.abstractmethod
     def try_get(self, key: str) -> Optional[bytes]: ...
 
+    def try_get_dir(self, prefix: str) -> Optional[dict]:
+        """All (key, value) pairs under ``prefix`` in ONE call when the
+        backend supports it, else None (caller falls back to per-key
+        gets). Keys in the result are relative to the store, like the
+        keys passed to ``set``."""
+        return None
+
+    def delete_prefix(self, prefix: str) -> None:
+        """Best-effort deletion of every key under ``prefix``."""
+
     def get(self, key: str, timeout_sec: float = _DEFAULT_TIMEOUT_SEC) -> bytes:
         deadline = time.monotonic() + timeout_sec
         while True:
@@ -80,6 +90,27 @@ class CoordinationKVStore(KVStore):
             raw = raw.decode()
         return base64.b64decode(raw)
 
+    def try_get_dir(self, prefix: str) -> Optional[dict]:
+        import base64
+
+        try:
+            pairs = self._client.key_value_dir_get(self._k(prefix))
+        except Exception:
+            return None
+        out = {}
+        strip = len(self._prefix) + 1
+        for k, v in pairs:
+            if isinstance(v, bytes):
+                v = v.decode()
+            out[k[strip:]] = base64.b64decode(v)
+        return out
+
+    def delete_prefix(self, prefix: str) -> None:
+        try:
+            self._client.key_value_delete(self._k(prefix))
+        except Exception:
+            pass
+
 
 class FileKVStore(KVStore):
     """Directory-backed store; atomic via rename. Works wherever ranks
@@ -113,6 +144,24 @@ class FileKVStore(KVStore):
         except FileNotFoundError:
             return None
 
+    def try_get_dir(self, prefix: str) -> Optional[dict]:
+        enc = prefix.replace("/", "%2F")
+        out = {}
+        for name in os.listdir(self.root):
+            if name.startswith(enc):
+                with open(os.path.join(self.root, name), "rb") as f:
+                    out[name.replace("%2F", "/")] = f.read()
+        return out
+
+    def delete_prefix(self, prefix: str) -> None:
+        enc = prefix.replace("/", "%2F")
+        for name in os.listdir(self.root):
+            if name.startswith(enc):
+                try:
+                    os.unlink(os.path.join(self.root, name))
+                except OSError:
+                    pass
+
 
 class MemoryKVStore(KVStore):
     """In-process store for single-process operation and unit tests."""
@@ -125,6 +174,15 @@ class MemoryKVStore(KVStore):
 
     def try_get(self, key: str) -> Optional[bytes]:
         return self._data.get(key)
+
+    def try_get_dir(self, prefix: str) -> Optional[dict]:
+        return {
+            k: v for k, v in self._data.items() if k.startswith(prefix)
+        }
+
+    def delete_prefix(self, prefix: str) -> None:
+        for k in [k for k in self._data if k.startswith(prefix)]:
+            del self._data[k]
 
 
 class LinearBarrierError(RuntimeError):

@@ -102,13 +102,15 @@ class ArrayBufferStager(BufferStager):
         # than 32 bits of evidence (small tile-less blobs record theirs
         # eagerly on every take — see _record_checksums).
         self.record_dedup_hashes = record_dedup_hashes
-        # Set by the take AFTER batching (single-process, non-incremental
-        # only): skip hashing at stage time; the write pipeline calls
-        # late_checksum with the staged buffer instead — the hash pass
-        # moves off the staging window async_take blocks training on and
-        # overlaps other requests' disk time. Multi-process manifests
-        # are gathered by value before writes complete, and incremental
-        # dedup needs hashes at stage time, so neither defers.
+        # Set by the take AFTER batching (non-incremental takes, any
+        # world size): skip hashing at stage time; the write pipeline
+        # calls late_checksum with the staged buffer instead — the hash
+        # pass moves off the staging window async_take blocks training
+        # on and overlaps other requests' disk time. Multi-process
+        # manifests gather by value at staging-complete, so the late
+        # values reach the commit via the barrier's KV store
+        # (snapshot._LateChecksums). Incremental dedup needs hashes at
+        # stage time and never defers.
         self.defer_checksums = False
         # User save-time transform (dtype cast / quantize-on-save),
         # applied to the ORIGINAL array at stage time with tracing=False
@@ -313,9 +315,10 @@ def _may_alias_live_memory(arr: ArrayLike, host: np.ndarray) -> bool:
     device array materializes a fresh host copy via DtoH — donation
     reuses device HBM, never that host buffer — so async takes on real
     accelerators skip the defensive clone entirely and their blocked
-    time is DMA alone (single-process takes defer even the hash to the
-    write path; multi-host takes gather manifests by value before
-    writes complete and still hash in the blocked window). Rather than
+    time is DMA alone (non-incremental takes at any world size defer
+    the hash to the write path; multi-process manifests receive the
+    late values via the commit barrier's KV store — see
+    snapshot._LateChecksums). Rather than
     trusting the platform name, the aliasing behavior is PROBED once
     per backend (``_asarray_aliases_device_buffer``). Host-resident
     (pinned_host, the UVM analog) arrays alias host memory on any
