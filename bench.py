@@ -13,10 +13,12 @@ result is interpretable on any disk:
   buffer-alignment class as user state arrays, so the same
   RWF_DONTCACHE/O_DIRECT routing), same thread pool, zero snapshot
   machinery on top. It is the fastest this byte layout can move with the
-  take's own engine and durability semantics, so ``roofline_fraction``
-  (take / roofline) reads directly as pipeline efficiency; values near
-  (or, under disk-bandwidth swings between the interleaved samples,
-  slightly above) 1.0 mean the pipeline adds nothing.
+  take's own engine and durability semantics. ``roofline_fraction``
+  (take / roofline, median of same-window pairs from the tight ~2 GB
+  probe — full-scale pairs span minutes and host contention drifts
+  inside them; their fractions are published as a diagnostic list)
+  reads directly as pipeline efficiency; ~1.0 means the pipeline adds
+  nothing.
 - The A100 baseline machine's local NVMe sustains multi-GB/s; this VM's
   virtio disk measures ~1-2 GB/s and swings >2x minute to minute
   (single-stream plain-buffered writes are host-throttled to ~0.2 GB/s),
@@ -46,16 +48,12 @@ result is interpretable on any disk:
   snapshot — all blobs dedup, so the cost is one CRC pass and no
   storage I/O (~9-10 GB/s effective on this host).
 - ``scrub_gbps`` / ``scrub_clean``: ``verify_snapshot`` re-reading and
-  checksum-verifying every stored byte. Like take and restore, the
-  scrub is sampled INTERLEAVED with its own roofline
-  (``scrub_roofline_gbps``): the exact byte ranges the scrub verifies,
-  read through the same native fused read+CRC engine at the same
-  concurrency (TPUSNAP_SCRUB_CONCURRENCY slots, reused scratch), with
-  zero manifest/asyncio machinery on top. ``scrub_roofline_fraction``
-  (median of same-round scrub/roofline pairs) is therefore pure
-  pipeline efficiency;
-  with per-run samples listed, a slow-disk window (this host swings
-  >2x) shows up as BOTH numbers dropping while the fraction holds.
+  checksum-verifying every stored byte — full-scale ABSOLUTES, with
+  an engine comparator (``scrub_roofline_gbps``: the exact byte
+  ranges the scrub verifies, read through the same native fused
+  read+CRC engine at the same concurrency) interleaved for context.
+  ``scrub_roofline_fraction`` is the median of same-round pairs from
+  the tight ~2 GB probe, like the take and restore fractions.
 
 Run policy: every timed section is preceded by ``os.sync()`` so it
 competes only with its own I/O, not earlier sections' writeback. The
@@ -334,20 +332,24 @@ def main() -> None:
 
         # Tight-window FRACTION probe (~2 GB: every sample is seconds,
         # so the paired samples genuinely share a disk window).
-        probe_bytes = min(TOTAL_BYTES, 2 * 1024**3)
-        probe_per = probe_bytes // N_ARRAYS
-        # Distinct-offset views into the random block (pairwise
-        # distinct bytes; the probe only feeds the fraction pairs, so
-        # the 16x-overlap source footprint is fine here); lengths
-        # equalized and offsets clamped so the smallest TOTAL_BYTES
-        # still fits.
-        probe_len = probe_per // 2 - N_ARRAYS
-        max_off = len(raw) - probe_len
-        step = max(1, min(997, max_off // max(N_ARRAYS - 1, 1)))
-        probe_state = {
-            f"w{i}": raw[i * step : i * step + probe_len].view(np.float16)
-            for i in range(N_ARRAYS)
-        }
+        def _build_probe_state():
+            """Distinct-offset views into the random block (pairwise
+            distinct bytes; probes only feed the fraction pairs, so
+            the overlapping source footprint is fine here); lengths
+            equalized and offsets clamped so the smallest TOTAL_BYTES
+            still fits. ONE definition so the take and restore
+            fraction probes can never desynchronize their scales."""
+            per = min(TOTAL_BYTES, 2 * 1024**3) // N_ARRAYS
+            plen = per // 2 - N_ARRAYS
+            step = max(
+                1, min(997, (len(raw) - plen) // max(N_ARRAYS - 1, 1))
+            )
+            return {
+                f"w{i}": raw[i * step : i * step + plen].view(np.float16)
+                for i in range(N_ARRAYS)
+            }
+
+        probe_state = _build_probe_state()
         probe_snap = os.path.join(bench_root, "fprobe", "snap")
         Snapshot.take(probe_snap, {"model": PytreeState(probe_state)})
         os.sync()
@@ -489,21 +491,25 @@ def main() -> None:
         # window measure the host's flush, not the scrub (same reason
         # the restore section runs first from a settled snapshot).
         time.sleep(8.0)
-        scrub_manifest = load_snapshot_metadata(last_snap).manifest
-        scrub_ranges = []  # (abs_path, offset, nbytes)
-        for b in iter_blobs(scrub_manifest):
-            off, end = b.byte_range if b.byte_range else (0, None)
-            if end is None:
-                end = os.path.getsize(os.path.join(last_snap, b.location))
-            scrub_ranges.append(
-                (os.path.join(last_snap, b.location), off, end - off)
-            )
-        scrub_bytes = sum(n for _, _, n in scrub_ranges)
+        def _scrub_ranges_of(snap_path):
+            manifest = load_snapshot_metadata(snap_path).manifest
+            ranges = []  # (abs_path, offset, nbytes)
+            for b in iter_blobs(manifest):
+                off, end = b.byte_range if b.byte_range else (0, None)
+                if end is None:
+                    end = os.path.getsize(
+                        os.path.join(snap_path, b.location)
+                    )
+                ranges.append(
+                    (os.path.join(snap_path, b.location), off, end - off)
+                )
+            return ranges
 
-        def scrub_roofline_once() -> float:
+        def _scrub_roofline_once(ranges) -> float:
             _drop_caches()
             n_slots = get_scrub_concurrency()
-            scratch = max(n for _, _, n in scrub_ranges)
+            scratch = max(n for _, _, n in ranges)
+            total = sum(n for _, _, n in ranges)
             local = __import__("threading").local()
 
             def read_one(rng):
@@ -519,28 +525,70 @@ def main() -> None:
 
             ex = ThreadPoolExecutor(max_workers=n_slots)
             t0 = time.perf_counter()
-            list(ex.map(read_one, scrub_ranges))
+            list(ex.map(read_one, ranges))
             el = time.perf_counter() - t0
             ex.shutdown()
-            return scrub_bytes / el / 1e9
+            return total / el / 1e9
 
+        scrub_ranges = _scrub_ranges_of(last_snap)
+        scrub_bytes = sum(n for _, _, n in scrub_ranges)
         scrub_runs = []
         scrub_rooflines = []
-        scrub_fracs = []
+        scrub_fullscale_fracs = []
         scrub_clean = True
         for _ in range(2):
-            rl = scrub_roofline_once()
-            scrub_rooflines.append(rl)
+            rl_fs = _scrub_roofline_once(scrub_ranges)
+            scrub_rooflines.append(rl_fs)
             _drop_caches()
             t0 = time.perf_counter()
             scrub_report = verify_snapshot(last_snap)
-            el = time.perf_counter() - t0
-            scrub_runs.append(el)
-            # Same-round pair (see the restore fractions).
-            scrub_fracs.append((scrub_bytes / el / 1e9) / rl)
+            el_fs = time.perf_counter() - t0
+            scrub_runs.append(el_fs)
+            scrub_fullscale_fracs.append(
+                (scrub_bytes / el_fs / 1e9) / rl_fs
+            )
             scrub_clean = scrub_clean and scrub_report.clean
         scrub_s = min(scrub_runs)
         scrub_roofline = max(scrub_rooflines)
+
+        # ---- tight-window fraction probe: take + scrub ----
+        # Same reasoning as the restore fractions: at full scale a
+        # single sample spans minutes and host contention drifts
+        # several-fold within a pair, so the FRACTIONS come from ~2 GB
+        # samples that take seconds; the full-scale runs above are the
+        # absolutes (their per-run fractions are published as a
+        # diagnostic list).
+        fprobe_dir = os.path.join(bench_root, "take_fprobe")
+        os.makedirs(fprobe_dir, exist_ok=True)
+        tp_state = _build_probe_state()
+        tp_file_bytes = next(iter(tp_state.values())).nbytes
+        tp_nbytes = sum(a.nbytes for a in tp_state.values())
+        take_probe_fracs = []
+        tp_snap = None
+        for r in range(5):
+            rl = measure_roofline(fprobe_dir, tp_file_bytes, N_ARRAYS)
+            tp_snap = os.path.join(fprobe_dir, f"t{r}", "snap")
+            os.sync()
+            t0 = time.perf_counter()
+            Snapshot.take(tp_snap, {"model": PytreeState(tp_state)})
+            el = time.perf_counter() - t0
+            take_probe_fracs.append((tp_nbytes / el / 1e9) / rl)
+            if r + 1 < 5:
+                shutil.rmtree(os.path.dirname(tp_snap), ignore_errors=True)
+        os.sync()
+        time.sleep(4.0)
+        tp_ranges = _scrub_ranges_of(tp_snap)
+        tp_bytes = sum(n for _, _, n in tp_ranges)
+        scrub_probe_fracs = []
+        for _ in range(3):
+            rl = _scrub_roofline_once(tp_ranges)
+            _drop_caches()
+            t0 = time.perf_counter()
+            rep = verify_snapshot(tp_snap)
+            el = time.perf_counter() - t0
+            scrub_clean = scrub_clean and rep.clean
+            scrub_probe_fracs.append((tp_bytes / el / 1e9) / rl)
+        shutil.rmtree(fprobe_dir, ignore_errors=True)
 
         # pinned_host (UVM analog) capability probe on the REAL backend,
         # via the wedge-proof runner (own process group, no inherited
@@ -621,13 +669,29 @@ def main() -> None:
                 "unit": "GB/s",
                 "vs_baseline": round(gbps / BASELINE_GBPS, 3),
                 "roofline_gbps": round(roofline, 3),
-                # Median of same-round take/roofline pairs (disk swings
-                # cancel within a pair; best-vs-best across windows
-                # does not bound the value).
+                # Median of same-round take/roofline pairs from the
+                # tight ~2 GB probe (seconds per sample, so the pair
+                # genuinely shares a host/disk window; full-scale
+                # pairs span minutes and drift several-fold — their
+                # fractions are published below as a diagnostic).
                 "roofline_fraction": round(
-                    statistics.median(take_fracs), 3
+                    statistics.median(take_probe_fracs), 3
+                ),
+                "roofline_fraction_probe_gb": round(
+                    min(TOTAL_BYTES, 2 * 1024**3) / 1024**3, 2
                 ),
                 "roofline_fraction_runs": [
+                    round(f, 3) for f in take_probe_fracs
+                ],
+                # Full-scale pairs for the same metric, published so
+                # the redefinition is auditable: at 20 GB each pair
+                # member spans minutes and host contention drifts
+                # inside the pair, which is WHY the headline fraction
+                # moved to the probe scale (r4->r5).
+                "roofline_fraction_fullscale": round(
+                    statistics.median(take_fracs), 3
+                ),
+                "roofline_fraction_fullscale_runs": [
                     round(f, 3) for f in take_fracs
                 ],
                 "roofline_runs_gbps": [round(r, 3) for r in rooflines],
@@ -682,12 +746,15 @@ def main() -> None:
                 "scrub_s": round(scrub_s, 2),
                 "scrub_gbps": round(scrub_bytes / scrub_s / 1e9, 3),
                 "scrub_roofline_gbps": round(scrub_roofline, 3),
-                # Median of same-round pairs, like the restore fractions.
+                # Median of same-round pairs from the tight probe.
                 "scrub_roofline_fraction": round(
-                    statistics.median(scrub_fracs), 3
+                    statistics.median(scrub_probe_fracs), 3
                 ),
                 "scrub_roofline_fraction_runs": [
-                    round(f, 3) for f in scrub_fracs
+                    round(f, 3) for f in scrub_probe_fracs
+                ],
+                "scrub_roofline_fraction_fullscale_runs": [
+                    round(f, 3) for f in scrub_fullscale_fracs
                 ],
                 "scrub_runs_gbps": [
                     round(scrub_bytes / t / 1e9, 3) for t in scrub_runs
