@@ -746,3 +746,60 @@ def test_multihost_simulated_budget_divisor():
             args=[f"{d}/snap"],
             hostnames=["hostA", "hostA", "hostB", "hostB"],
         )
+
+
+def _world_late_checksums(snap_dir):
+    """Multi-process deferred checksums: the committed metadata carries
+    every rank's checksums (hashed on the write path, transported via
+    the commit barrier's KV store), a non-leader rank's returned handle
+    verifies clean (it reads the committed file rather than its stale
+    in-memory gather), and the take-scoped KV keys are DELETED after
+    the commit — one leaked blob per rank per take would grow the
+    coordination service for the job's lifetime."""
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict
+    from tpusnap.comm import get_communicator
+    from tpusnap.snapshot import _get_kv_store
+
+    comm = get_communicator()
+    rank = comm.rank
+    state = StateDict(
+        w=np.arange(512 * 64, dtype=np.float32).reshape(512, 64) + rank,
+        small=np.ones(32, np.float32) * rank,
+    )
+    snap = Snapshot.take(snap_dir, {"app": state})
+    # The deferral path actually ENGAGED: take withholds the cached
+    # in-memory metadata on non-leaders exactly when _LateChecksums is
+    # active — without this, a regression to eager hashing would make
+    # every later assertion here pass vacuously.
+    assert (snap._metadata is None) == (rank != 0), rank
+    # Every rank's handle — leader or not — sees full checksums.
+    report = snap.verify()
+    assert report.clean, (rank, report.summary())
+    manifest = Snapshot(snap_dir).metadata.manifest
+    for key in (f"{r}/app/w" for r in range(comm.world_size)):
+        assert manifest[key].checksum is not None, key
+    # The late-checksum KV keys were cleaned up by rank 0's apply.
+    comm.barrier()
+    store = _get_kv_store(comm)
+    leftovers = store.try_get_dir("tpusnap_late_cs/")
+    # None would mean the listing itself failed — the leak check must
+    # OBSERVE an empty directory, not fail to look.
+    assert leftovers is not None and not leftovers, leftovers
+
+    # Async path: same properties.
+    pending = Snapshot.async_take(snap_dir + "_a", {"app": state})
+    snap2 = pending.wait()
+    assert (snap2._metadata is None) == (rank != 0), rank
+    assert snap2.verify().clean, rank
+    comm.barrier()
+    leftovers = store.try_get_dir("tpusnap_late_cs/")
+    assert leftovers is not None and not leftovers, leftovers
+
+
+def test_late_checksums_world2():
+    with tempfile.TemporaryDirectory() as d:
+        run_subprocess_world(
+            _world_late_checksums, world_size=2, args=[f"{d}/snap"]
+        )
