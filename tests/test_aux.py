@@ -25,12 +25,22 @@ class TestHostOffload:
 
     def test_roundtrip_and_predicates(self):
         x = jnp.arange(64, dtype=jnp.float32)
-        assert not is_host_resident(x)  # default memory kind "device"
+        # Whether a DEFAULT-placed array counts as host-resident depends
+        # on the backend's default memory kind: TPU/GPU default to
+        # device memory, but newer JAX CPU backends default to
+        # unpinned_host — where reporting host residency is correct
+        # (the save path rightly skips the DtoH staging copy there).
+        try:
+            default_kind = x.devices().pop().default_memory().kind
+        except Exception:
+            default_kind = "device"
+        default_is_host = default_kind in ("pinned_host", "unpinned_host")
+        assert is_host_resident(x) == default_is_host
         xh = to_host_offload(x, "unpinned_host")
         assert is_host_resident(xh)
         np.testing.assert_array_equal(np.asarray(xh), np.asarray(x))
         xd = to_device(xh)
-        assert not is_host_resident(xd)
+        assert is_host_resident(xd) == default_is_host
         np.testing.assert_array_equal(np.asarray(xd), np.asarray(x))
 
     def test_numpy_is_host_resident(self):

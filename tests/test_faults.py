@@ -1,0 +1,407 @@
+"""Chaos layer tests: deterministic fault injection + unified retry
+middleware.
+
+The fast seeds run in tier-1 (``chaos`` marker); the wide seed sweep is
+``slow`` and excluded. Every end-to-end case asserts the two invariants
+the robustness subsystem promises:
+
+- the retry middleware CONVERGES: with ≥1 transient error injected per
+  storage op (plus torn writes and short reads), take/restore/verify
+  still succeed bit-exact through ``chaos+<scheme>://``;
+- torn writes never corrupt a committed snapshot: whatever the fault
+  schedule, a committed snapshot scrubs clean (``verify_snapshot``).
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from tpusnap import (
+    FaultPlan,
+    InjectedFaultError,
+    RetryPolicy,
+    Snapshot,
+    StateDict,
+    verify_snapshot,
+)
+from tpusnap.faults import FaultInjectionStoragePlugin
+from tpusnap.io_types import ReadIO, WriteIO
+from tpusnap.retry import RetryingStoragePlugin, default_classify_transient
+from tpusnap.storage_plugins.fs import FSStoragePlugin
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+_FAST_OPTS = {"retry_backoff_base_sec": 0.01, "retry_backoff_cap_sec": 0.05}
+
+
+def _chaos_opts(plan: FaultPlan) -> dict:
+    return dict(_FAST_OPTS, fault_plan=plan)
+
+
+def _state(seed: int, n_arrays: int = 5, size: int = 4096) -> dict:
+    return {
+        f"w{i}": np.random.default_rng(seed * 100 + i)
+        .standard_normal(size)
+        .astype(np.float32)
+        for i in range(n_arrays)
+    }
+
+
+# --------------------------------------------------------------- FaultPlan
+
+
+def test_fault_plan_spec_parsing():
+    plan = FaultPlan.from_spec(
+        "seed=3,transient_per_op=2,latency_ms=5,torn_writes=1,"
+        "short_reads=1,crash_after_op=write:7"
+    )
+    assert plan.seed == 3
+    assert plan.transient_per_op == 2
+    assert abs(plan.latency_sec - 0.005) < 1e-9
+    assert plan.torn_writes and plan.short_reads
+    assert plan.crash_after_op == ("write", 7)
+    with pytest.raises(ValueError, match="Unknown fault spec key"):
+        FaultPlan.from_spec("bogus=1")
+
+
+def test_fault_plan_coerce_env(monkeypatch):
+    monkeypatch.setenv("TPUSNAP_FAULT_SPEC", "seed=9,transient_every=4")
+    plan = FaultPlan.coerce(None)
+    assert plan.seed == 9 and plan.transient_every == 4
+    monkeypatch.delenv("TPUSNAP_FAULT_SPEC")
+    assert FaultPlan.coerce(None).transient_per_op == 1  # default misbehaves
+    assert FaultPlan.coerce({"seed": 2}).seed == 2
+    same = FaultPlan(seed=5)
+    assert FaultPlan.coerce(same) is same
+
+
+def test_fault_plan_determinism(tmp_path):
+    """Identical seeds inject identical fault schedules over a serial op
+    sequence."""
+
+    def fire_sequence(seed):
+        plugin = FaultInjectionStoragePlugin(
+            FSStoragePlugin(root=str(tmp_path / f"d{seed}")),
+            FaultPlan(seed=seed, transient_every=3),
+        )
+        fired = []
+
+        async def go():
+            for i in range(12):
+                try:
+                    await plugin.write(WriteIO(path=f"o{i}", buf=b"x"))
+                    fired.append(False)
+                except InjectedFaultError:
+                    fired.append(True)
+            await plugin.close()
+
+        _run(go())
+        return fired
+
+    a, b = fire_sequence(1), fire_sequence(1)
+    assert a == b
+    assert sum(a) == 4  # ops 3, 6, 9, 12 of 12
+
+
+# ------------------------------------------------------------------ retry
+
+
+class _FlakyPlugin(FSStoragePlugin):
+    """Raises a configurable exception for the first N attempts per op."""
+
+    def __init__(self, root, fail_times=1, exc_factory=None):
+        super().__init__(root)
+        self.fail_times = fail_times
+        self.exc_factory = exc_factory or (
+            lambda: ConnectionResetError("flaky")
+        )
+        self.attempts = {}
+
+    def _maybe_fail(self, key):
+        n = self.attempts.get(key, 0)
+        self.attempts[key] = n + 1
+        if n < self.fail_times:
+            raise self.exc_factory()
+
+    async def write(self, write_io):
+        self._maybe_fail(("write", write_io.path))
+        await super().write(write_io)
+
+    async def read(self, read_io):
+        self._maybe_fail(("read", read_io.path))
+        await super().read(read_io)
+
+    async def delete(self, path):
+        self._maybe_fail(("delete", path))
+        await super().delete(path)
+
+
+def test_retrying_plugin_converges(tmp_path):
+    inner = _FlakyPlugin(str(tmp_path), fail_times=2)
+    plugin = RetryingStoragePlugin(
+        inner, RetryPolicy(backoff_base_sec=0.01, backoff_cap_sec=0.02)
+    )
+    data = os.urandom(100_000)
+
+    async def go():
+        await plugin.write(WriteIO(path="a/b", buf=data))
+        read_io = ReadIO(path="a/b")
+        await plugin.read(read_io)
+        assert read_io.buf.getvalue() == data
+        await plugin.delete("a/b")
+        await plugin.close()
+
+    _run(go())
+    assert inner.attempts[("write", "a/b")] == 3  # 2 failures + success
+
+
+def test_retrying_plugin_fatal_error_raises_immediately(tmp_path):
+    inner = _FlakyPlugin(
+        str(tmp_path),
+        fail_times=100,
+        exc_factory=lambda: PermissionError("denied"),
+    )
+    plugin = RetryingStoragePlugin(
+        inner, RetryPolicy(backoff_base_sec=0.01)
+    )
+    with pytest.raises(PermissionError):
+        _run(plugin.write(WriteIO(path="x", buf=b"data")))
+    # one attempt only: PermissionError (EACCES-class) is not transient
+    assert inner.attempts[("write", "x")] == 1
+
+
+def test_retrying_plugin_deadline_expiry(tmp_path):
+    inner = _FlakyPlugin(str(tmp_path), fail_times=10_000)
+    plugin = RetryingStoragePlugin(
+        inner,
+        RetryPolicy(
+            deadline_sec=0.2, backoff_base_sec=0.02, backoff_cap_sec=0.05
+        ),
+    )
+    with pytest.raises(ConnectionResetError):
+        _run(plugin.write(WriteIO(path="x", buf=b"data")))
+
+
+def test_default_transient_classification():
+    import errno as errno_mod
+
+    assert default_classify_transient(ConnectionResetError("x"))
+    assert default_classify_transient(TimeoutError("x"))
+    assert default_classify_transient(InjectedFaultError("x"))
+    assert default_classify_transient(
+        OSError(errno_mod.EAGAIN, "again")
+    )
+    assert not default_classify_transient(OSError(errno_mod.ENOSPC, "full"))
+    assert not default_classify_transient(ValueError("x"))
+    assert not default_classify_transient(OSError("no errno"))
+
+    class _Resp:
+        status_code = 503
+
+    class _HttpErr(Exception):
+        response = _Resp()
+
+    assert default_classify_transient(_HttpErr())
+
+
+def test_retry_read_attempts_never_leak_torn_buffers(tmp_path):
+    """A failing read that delivered partial bytes must not surface them:
+    each retry attempt runs against a fresh ReadIO."""
+    plugin = RetryingStoragePlugin(
+        FaultInjectionStoragePlugin(
+            FSStoragePlugin(root=str(tmp_path)),
+            FaultPlan(seed=0, transient_per_op=1, short_reads=True),
+        ),
+        RetryPolicy(backoff_base_sec=0.01),
+    )
+    data = os.urandom(50_000)
+
+    async def go():
+        await plugin.write(WriteIO(path="blob", buf=data))
+        read_io = ReadIO(path="blob")
+        await plugin.read(read_io)
+        assert read_io.buf.getvalue() == data
+        await plugin.close()
+
+    _run(go())
+
+
+# ------------------------------------------------------------- chaos e2e
+
+
+def _chaos_roundtrip(url: str, opts: dict, seed: int) -> None:
+    state = _state(seed)
+    Snapshot.take(url, {"m": StateDict(**state)}, storage_options=opts)
+    target = {"m": StateDict(**{k: np.zeros_like(v) for k, v in state.items()})}
+    Snapshot(url, storage_options=opts).restore(target)
+    for k, v in state.items():
+        assert np.array_equal(target["m"][k], v), k
+    report = verify_snapshot(url, storage_options=opts)
+    assert report.clean, report
+
+
+_FAST_CHAOS_SEEDS = [0, 1]
+_SLOW_CHAOS_SEEDS = range(2, 12)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", _FAST_CHAOS_SEEDS)
+def test_chaos_fs_roundtrip(tmp_path, seed):
+    """≥1 transient error per storage op + torn writes + short reads over
+    chaos+fs://: the retry middleware converges and the committed
+    snapshot is bit-exact and scrubs clean."""
+    plan = FaultPlan(
+        seed=seed, transient_per_op=1, torn_writes=True, short_reads=True
+    )
+    _chaos_roundtrip(
+        f"chaos+fs://{tmp_path}/snap", _chaos_opts(plan), seed
+    )
+
+
+@pytest.mark.chaos
+def test_chaos_fsspec_memory_roundtrip(tmp_path):
+    plan = FaultPlan(seed=3, transient_per_op=1, short_reads=True)
+    _chaos_roundtrip(
+        "chaos+fsspec+memory://chaos_mem_snap", _chaos_opts(plan), 3
+    )
+
+
+@pytest.mark.chaos
+def test_chaos_latency_and_every_n(tmp_path):
+    """Latency injection and every-Nth-op faults compose with per-op
+    transients."""
+    plan = FaultPlan(
+        seed=4,
+        transient_per_op=1,
+        transient_every=5,
+        latency_sec=0.001,
+        torn_writes=True,
+    )
+    _chaos_roundtrip(
+        f"chaos+fs://{tmp_path}/snap", _chaos_opts(plan), 4
+    )
+
+
+@pytest.mark.chaos
+def test_chaos_s3_stub_ops(tmp_path):
+    """The s3 plugin's ops converge under chaos through the retry
+    middleware (stub client: aiobotocore is not installed here)."""
+    from test_s3 import StubS3Client
+    from tpusnap.storage_plugins.s3 import S3StoragePlugin
+
+    raw = S3StoragePlugin("bucket/prefix")
+    raw._client = StubS3Client()
+    plugin = RetryingStoragePlugin(
+        FaultInjectionStoragePlugin(
+            raw,
+            FaultPlan(seed=5, transient_per_op=1, short_reads=True),
+        ),
+        RetryPolicy(backoff_base_sec=0.01),
+    )
+    blobs = {f"o{i}": os.urandom(10_000 + i) for i in range(6)}
+
+    async def go():
+        await asyncio.gather(
+            *(plugin.write(WriteIO(path=k, buf=v)) for k, v in blobs.items())
+        )
+        for k, v in blobs.items():
+            read_io = ReadIO(path=k)
+            await plugin.read(read_io)
+            assert read_io.buf.getvalue() == v, k
+        ranged = ReadIO(path="o0", byte_range=(100, 900))
+        await plugin.read(ranged)
+        assert ranged.buf.getvalue() == blobs["o0"][100:900]
+
+    _run(go())
+
+
+@pytest.mark.chaos
+def test_chaos_incremental_dedup_survives_faults(tmp_path):
+    """Incremental takes through a chaotic backend: dedup decisions and
+    base references stay correct under injected faults."""
+    from tpusnap.knobs import override_batching_disabled
+
+    plan = FaultPlan(seed=6, transient_per_op=1, torn_writes=True)
+    opts = _chaos_opts(plan)
+    state = _state(6, n_arrays=3)
+    with override_batching_disabled(True):
+        Snapshot.take(
+            f"chaos+fs://{tmp_path}/s0",
+            {"m": StateDict(**state)},
+            storage_options=opts,
+        )
+        Snapshot.take(
+            f"chaos+fs://{tmp_path}/s1",
+            {"m": StateDict(**state)},
+            storage_options=opts,
+            incremental_from=f"chaos+fs://{tmp_path}/s0",
+        )
+    target = {"m": StateDict(**{k: np.zeros_like(v) for k, v in state.items()})}
+    Snapshot(f"chaos+fs://{tmp_path}/s1", storage_options=opts).restore(target)
+    for k, v in state.items():
+        assert np.array_equal(target["m"][k], v), k
+    assert verify_snapshot(
+        f"chaos+fs://{tmp_path}/s1", storage_options=opts
+    ).clean
+
+
+@pytest.mark.chaos
+def test_chaos_transient_every_1_converges(tmp_path):
+    """transient_every=1 fails every op's FIRST attempt; retries are
+    exempt from the every-Nth draw, so the take still converges."""
+    plan = FaultPlan(seed=8, transient_every=1)
+    _chaos_roundtrip(f"chaos+fs://{tmp_path}/snap", _chaos_opts(plan), 8)
+
+
+def test_progress_deadline_arms_lazily():
+    """A plugin built long before its first op (async takes) must grant
+    the first failing op a full retry window — the deadline starts at
+    first consult, not construction."""
+    from tpusnap.retry import ProgressDeadline
+
+    deadline = ProgressDeadline(deadline_sec=0.0)  # instantly expirable
+    # First consult arms the window and reports NOT expired even though
+    # construction was arbitrarily long ago.
+    assert not deadline.expired()
+
+
+@pytest.mark.chaos
+def test_chaos_async_take_roundtrip(tmp_path):
+    """The background commit drain retries injected faults off the main
+    thread; wait() returns a committed, clean snapshot."""
+    plan = FaultPlan(seed=11, transient_per_op=1, torn_writes=True)
+    opts = _chaos_opts(plan)
+    url = f"chaos+fs://{tmp_path}/snap"
+    state = _state(11)
+    pending = Snapshot.async_take(
+        url, {"m": StateDict(**state)}, storage_options=opts
+    )
+    snap = pending.wait()
+    target = {"m": StateDict(**{k: np.zeros_like(v) for k, v in state.items()})}
+    snap.restore(target)
+    for k, v in state.items():
+        assert np.array_equal(target["m"][k], v), k
+    assert verify_snapshot(url, storage_options=opts).clean
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", _SLOW_CHAOS_SEEDS)
+def test_chaos_fs_roundtrip_seed_sweep(tmp_path, seed):
+    """Wider seed sweep of the same invariants (excluded from tier-1)."""
+    plan = FaultPlan(
+        seed=seed,
+        transient_per_op=1,
+        transient_every=7,
+        torn_writes=True,
+        short_reads=True,
+        latency_sec=0.001,
+    )
+    _chaos_roundtrip(
+        f"chaos+fs://{tmp_path}/snap", _chaos_opts(plan), seed
+    )

@@ -44,8 +44,10 @@ class TestRingAttention:
         )
         ref = _dense_causal_attention(q, k, v)
         spec = P("data", "fsdp", "tensor", None)
+        from tpusnap.models.transformer import _shard_map
+
         fn = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 functools.partial(ring_attention, axis_name="fsdp", causal=True),
                 mesh=mesh,
                 in_specs=(spec, spec, spec),

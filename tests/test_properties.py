@@ -9,7 +9,12 @@ CRC-combine identity over arbitrary byte splits.
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from tpusnap.flatten import flatten, inflate
 from tpusnap.manifest import (

@@ -19,21 +19,60 @@ def _run(coro):
 
 
 def test_registry_schemes(tmp_path):
+    from tpusnap.retry import RetryingStoragePlugin
+
+    # Built-in plugins come wrapped in the whole-op retry middleware.
     p = url_to_storage_plugin(str(tmp_path))
-    assert isinstance(p, FSStoragePlugin)
+    assert isinstance(p, RetryingStoragePlugin)
+    assert isinstance(p.inner, FSStoragePlugin)
     p = url_to_storage_plugin(f"fs://{tmp_path}")
+    assert isinstance(p.inner, FSStoragePlugin)
+    # storage_options={"retry": False} returns the bare plugin.
+    p = url_to_storage_plugin(str(tmp_path), {"retry": False})
     assert isinstance(p, FSStoragePlugin)
     p = url_to_storage_plugin(f"fsspec+memory://snap")
     from tpusnap.storage_plugins.fsspec import FsspecStoragePlugin
 
-    assert isinstance(p, FsspecStoragePlugin)
+    assert isinstance(p.inner, FsspecStoragePlugin)
     with pytest.raises(RuntimeError, match="Unsupported storage scheme"):
         url_to_storage_plugin("bogus://x")
     # S3 construction succeeds without aiobotocore (deferred import so a
     # stub client can be injected); first real use raises.
     s3 = url_to_storage_plugin("s3://bucket/prefix")
     with pytest.raises(RuntimeError, match="aiobotocore"):
-        _run(s3._get_client())
+        _run(s3.inner._get_client())
+
+
+def test_registry_chaos_scheme(tmp_path):
+    """chaos+<scheme>:// composes Retrying(FaultInjection(raw)) so
+    injected faults exercise the production retry path."""
+    from tpusnap.faults import FaultInjectionStoragePlugin, FaultPlan
+    from tpusnap.retry import RetryingStoragePlugin
+
+    p = url_to_storage_plugin(f"chaos+fs://{tmp_path}")
+    assert isinstance(p, RetryingStoragePlugin)
+    assert isinstance(p.inner, FaultInjectionStoragePlugin)
+    assert isinstance(p.inner.inner, FSStoragePlugin)
+    # default plan: ≥1 transient error per distinct op
+    assert p.inner.plan.transient_per_op == 1
+    # explicit plans ride storage_options (FaultPlan, spec str, or dict)
+    p = url_to_storage_plugin(
+        f"chaos+fs://{tmp_path}",
+        {"fault_plan": FaultPlan(seed=7, transient_every=3, torn_writes=True)},
+    )
+    assert p.inner.plan.seed == 7 and p.inner.plan.torn_writes
+    p = url_to_storage_plugin(
+        f"chaos+fs://{tmp_path}",
+        {"fault_plan": "seed=2,transient_per_op=2,latency_ms=1"},
+    )
+    assert p.inner.plan.seed == 2
+    assert p.inner.plan.transient_per_op == 2
+    assert abs(p.inner.plan.latency_sec - 0.001) < 1e-9
+    # chaos over the generic fsspec bridge
+    p = url_to_storage_plugin("chaos+fsspec+memory://snapchaos")
+    from tpusnap.storage_plugins.fsspec import FsspecStoragePlugin
+
+    assert isinstance(p.inner.inner, FsspecStoragePlugin)
 
 
 def test_fs_write_read_roundtrip(tmp_path):

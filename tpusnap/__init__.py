@@ -30,10 +30,17 @@ try:  # pragma: no cover - import surface grows as modules land
     )
     from .rss_profiler import measure_rss_deltas  # noqa: F401
     from .inspect import ScrubReport, verify_snapshot  # noqa: F401
+    from .dist_store import TakeAbortedError  # noqa: F401
+    from .retry import RetryPolicy  # noqa: F401
+    from .faults import FaultPlan, InjectedFaultError  # noqa: F401
 
     __all__ += [
         "ScrubReport",
         "verify_snapshot",
+        "TakeAbortedError",
+        "RetryPolicy",
+        "FaultPlan",
+        "InjectedFaultError",
         "Snapshot",
         "PendingSnapshot",
         "PendingRestore",

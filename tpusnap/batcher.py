@@ -304,10 +304,15 @@ def _device_group_key(stager: BufferStager) -> Optional[str]:
     if not isinstance(arr, jax.Array):
         return None
     try:
-        from .host_offload import is_host_resident
+        from .host_offload import is_offloaded_to_host
 
-        if is_host_resident(arr):
-            return None  # already host memory; DMA would be a detour
+        if is_offloaded_to_host(arr):
+            # Genuinely offloaded (host kind distinct from the device's
+            # default memory): packing on device would round-trip the
+            # bytes through a DMA for nothing. Default-placed arrays on
+            # backends whose default memory IS a host kind (CPU) still
+            # device-pack — there the pack is a fused concat, no DMA.
+            return None
         devices = arr.devices()
     except Exception:
         return None

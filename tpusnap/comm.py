@@ -86,6 +86,20 @@ class Communicator:
         AFTER the proof point. Pure KV deletes: safe from any thread."""
         return None
 
+    def set_wait_watcher(self, watcher) -> None:
+        """Install a callable run periodically inside every collective
+        wait; it may raise to abort the wait early (take-abort
+        propagation). While installed, barriers and blocking gets switch
+        from the coordination service's native blocking RPCs (which
+        cannot be interrupted before their timeout) to KV polling. ALL
+        ranks must install/clear at the same point in their collective
+        program — the polling barrier only interoperates with itself.
+        No-op on the single-process communicator."""
+        return None
+
+    def clear_wait_watcher(self) -> None:
+        return None
+
 
 _instance_count = 0
 
@@ -143,6 +157,8 @@ class JaxCoordinationComm(Communicator):
         # while the main thread may be appending for a newer take.
         self._gc_pending: List[str] = []
         self._gc_lock = threading.Lock()
+        # Optional abort watcher (see Communicator.set_wait_watcher).
+        self._wait_watcher = None
 
     @property
     def rank(self) -> int:
@@ -180,8 +196,31 @@ class JaxCoordinationComm(Communicator):
             except Exception:
                 pass
 
+    def set_wait_watcher(self, watcher) -> None:
+        self._wait_watcher = watcher
+
+    def clear_wait_watcher(self) -> None:
+        self._wait_watcher = None
+
     def barrier(self) -> None:
         seq = self._next_seq()
+        if self._wait_watcher is not None:
+            # Abort-aware mode: the native wait_at_barrier blocks inside
+            # the coordination client until its timeout and cannot
+            # observe an abort record. Substitute a KV polling barrier
+            # (arrive keys + a depart key, LinearBarrier-style) that
+            # runs the watcher every poll. All ranks take this branch
+            # for the same seq because watcher installation is a fixed
+            # point in the take's SPMD program.
+            prefix = self._polling_barrier(seq)
+            # Flush BEFORE registering this barrier's own prefix: the
+            # flush must never delete the depart key a slow rank is
+            # still polling — this prefix is only provably consumed
+            # after the NEXT barrier.
+            self._flush_gc()
+            with self._gc_lock:
+                self._gc_pending.append(prefix + "/")
+            return
         # Namespace components contain no "." (auto ids are digits,
         # explicit ones are sanitized), so this mapping is injective —
         # distinct namespaces can never satisfy each other's barriers.
@@ -190,6 +229,58 @@ class JaxCoordinationComm(Communicator):
             timeout_in_ms=self._timeout_ms,
         )
         self._flush_gc()
+
+    def _watched_wait_key(self, key: str, deadline: float):
+        """Poll ``key`` until present (returning its value), running the
+        wait watcher (which may raise) every iteration."""
+        import time
+
+        from .dist_store import _client_try_get
+
+        while True:
+            watcher = self._wait_watcher
+            if watcher is not None:
+                watcher()
+            # The probe blocks up to its own 50ms timeout on older
+            # clients without key_value_try_get, doubling as the poll
+            # interval there.
+            value = _client_try_get(self._client, key)
+            if value is not None:
+                return value
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"Timed out waiting for coordination key {key!r}"
+                )
+            time.sleep(0.05)
+
+    def _polling_barrier(self, seq: int) -> str:
+        """KV-polling two-phase barrier, interoperable only with itself:
+        every rank sets an arrive key; rank 0 collects them and sets the
+        depart key; non-leaders wait for depart. Returns the key prefix;
+        the caller registers it for GC after a LATER barrier proves
+        every rank has passed this one (the same lazy proof as
+        collective payload keys — deleting the depart key any earlier
+        could strand a slow rank).
+
+        Deliberately NOT dist_store.LinearBarrier: that rides
+        CoordinationKVStore, whose keys live under its own store prefix
+        — outside this communicator's namespace, invisible to the
+        _gc_pending raw-client deletes that keep per-take keys from
+        accumulating in the coordination service for the job's
+        lifetime. Keeping the barrier on raw client keys inside
+        ``{ns}/`` makes the existing GC proof cover it for free."""
+        import time
+
+        prefix = f"{self._namespace()}/pb{seq}"
+        deadline = time.monotonic() + self._timeout_ms / 1000.0
+        self._client.key_value_set(f"{prefix}/a/{self._rank}", "1")
+        if self._rank == 0:
+            for r in range(1, self._world_size):
+                self._watched_wait_key(f"{prefix}/a/{r}", deadline)
+            self._client.key_value_set(f"{prefix}/d", "1")
+        else:
+            self._watched_wait_key(f"{prefix}/d", deadline)
+        return prefix
 
     def gc_epoch(self) -> int:
         with self._gc_lock:
@@ -229,6 +320,17 @@ class JaxCoordinationComm(Communicator):
         if self._rank == src:
             self._client.key_value_set(key, _encode(obj))
             result = obj
+        elif self._wait_watcher is not None:
+            # Abort-aware wait: the native blocking get cannot be
+            # interrupted before its timeout; poll instead, running the
+            # watcher (which may raise) each iteration.
+            import time
+
+            result = _decode(
+                self._watched_wait_key(
+                    key, time.monotonic() + self._timeout_ms / 1000.0
+                )
+            )
         else:
             result = _decode(
                 self._client.blocking_key_value_get(key, self._timeout_ms)

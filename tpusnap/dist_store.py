@@ -19,11 +19,14 @@ store instead:
 from __future__ import annotations
 
 import abc
+import logging
 import os
 import pickle
 import tempfile
 import time
-from typing import Optional
+from typing import Callable, List, Optional
+
+logger = logging.getLogger(__name__)
 
 _DEFAULT_TIMEOUT_SEC = 600.0
 _POLL_INTERVAL_SEC = 0.05
@@ -57,6 +60,22 @@ class KVStore(abc.ABC):
             time.sleep(_POLL_INTERVAL_SEC)
 
 
+def _client_try_get(client, full_key: str, probe_timeout_ms: int = 50):
+    """Non-blocking-ish single-key get against the coordination client.
+
+    Newer JAX exposes ``key_value_try_get``; older clients (including
+    jaxlib 0.4.x) only have ``blocking_key_value_get``, which raises on
+    timeout — probe with a short deadline there. Returns None when the
+    key is absent (or the service errored)."""
+    getter = getattr(client, "key_value_try_get", None)
+    try:
+        if getter is not None:
+            return getter(full_key)
+        return client.blocking_key_value_get(full_key, probe_timeout_ms)
+    except Exception:
+        return None
+
+
 class CoordinationKVStore(KVStore):
     """Backed by the jax.distributed coordination service client."""
 
@@ -80,10 +99,7 @@ class CoordinationKVStore(KVStore):
     def try_get(self, key: str) -> Optional[bytes]:
         import base64
 
-        try:
-            raw = self._client.key_value_try_get(self._k(key))
-        except Exception:
-            return None
+        raw = _client_try_get(self._client, self._k(key))
         if raw is None:
             return None
         if isinstance(raw, bytes):
@@ -98,11 +114,20 @@ class CoordinationKVStore(KVStore):
         except Exception:
             return None
         out = {}
-        strip = len(self._prefix) + 1
+        want = self._prefix + "/"
         for k, v in pairs:
             if isinstance(v, bytes):
                 v = v.decode()
-            out[k[strip:]] = base64.b64decode(v)
+            # Defensive stripping: the coordination service is only
+            # OBSERVED to return keys exactly as set; verify the prefix
+            # instead of blind slicing (tolerating a leading slash), and
+            # report "no dir support" on any unexpected shape so callers
+            # take their per-key fallback rather than consuming
+            # silently corrupted relative keys.
+            rel_key = k.lstrip("/")
+            if not rel_key.startswith(want):
+                return None
+            out[rel_key[len(want) :]] = base64.b64decode(v)
         return out
 
     def delete_prefix(self, prefix: str) -> None:
@@ -189,11 +214,133 @@ class LinearBarrierError(RuntimeError):
     pass
 
 
+class TakeAbortedError(RuntimeError):
+    """Another rank's take failed: its abort record was published through
+    the coordination KV store, and this rank's barrier/commit wait raised
+    within seconds instead of burning the full barrier timeout. The path
+    is reusable — no ``.snapshot_metadata`` was written, and each rank
+    best-effort deleted its staged blobs."""
+
+
+class TakeAbortMonitor:
+    """Distributed take-abort propagation over the coordination KV store.
+
+    When any rank's take fails, it ``publish``es an abort record under a
+    take-scoped prefix; every other rank's waits (polling commit
+    barriers, the background commit's LinearBarrier) run ``check`` as a
+    watcher and raise :class:`TakeAbortedError` within
+    ``check_interval_sec`` + one poll interval. Records are left behind
+    on abort (take-scoped keys, a few bytes; the next take uses a fresh
+    take_id) and the prefix is deleted on a successful commit."""
+
+    _PREFIX = "tpusnap_abort"
+
+    def __init__(
+        self,
+        store: KVStore,
+        take_id: str,
+        rank: int,
+        check_interval_sec: float = 0.25,
+    ) -> None:
+        self._store = store
+        self.take_id = take_id
+        self.rank = rank
+        self._interval = check_interval_sec
+        self._last_check = 0.0
+        self._published = False
+
+    def _prefix(self) -> str:
+        return f"{self._PREFIX}/{self.take_id}/"
+
+    def publish(self, exc: BaseException) -> None:
+        """Record this rank's failure for every peer to observe."""
+        if self._published:
+            return
+        self._published = True
+        try:
+            payload = pickle.dumps(exc)
+        except Exception:
+            payload = pickle.dumps(RuntimeError(repr(exc)))
+        try:
+            self._store.set(f"{self._prefix()}r{self.rank}", payload)
+        except Exception:
+            logger.warning(
+                "Failed to publish take-abort record for take %s",
+                self.take_id,
+                exc_info=True,
+            )
+
+    def mark_commit_started(self) -> None:
+        """Committing-rank flag set right before the metadata write.
+        Aborting ranks consult it: once the commit may exist, staged
+        blobs must NOT be deleted (a committed manifest references
+        them — orphan blobs are safe, dangling references are not)."""
+        try:
+            self._store.set(f"{self._prefix()}commit_started", b"1")
+        except Exception:
+            pass
+
+    def commit_may_have_started(self) -> bool:
+        try:
+            return (
+                self._store.try_get(f"{self._prefix()}commit_started")
+                is not None
+            )
+        except Exception:
+            # Unknown — be conservative and keep the blobs.
+            return True
+
+    def check(self, force: bool = False) -> None:
+        """Raise :class:`TakeAbortedError` if any rank published an abort
+        record. RPC-throttled to ``check_interval_sec`` unless forced."""
+        now = time.monotonic()
+        if not force and now - self._last_check < self._interval:
+            return
+        self._last_check = now
+        try:
+            records = self._store.try_get_dir(self._prefix())
+        except Exception:
+            return
+        if not records:
+            return
+        # try_get_dir keys are store-relative (they include the prefix).
+        prefix = self._prefix()
+        aborts = sorted(
+            (k[len(prefix) :], v)
+            for k, v in records.items()
+            if k.startswith(prefix) and k[len(prefix) :].startswith("r")
+        )
+        if not aborts:
+            return
+        rank_key, payload = aborts[0]
+        try:
+            cause: Optional[BaseException] = pickle.loads(payload)
+        except Exception:
+            cause = None
+        err = TakeAbortedError(
+            f"take {self.take_id} aborted by rank {rank_key[1:]}: {cause!r}"
+        )
+        if cause is not None:
+            raise err from cause
+        raise err
+
+    def clear(self) -> None:
+        """Best-effort deletion of the take's abort prefix (leader calls
+        this after a successful commit so the service does not accumulate
+        per-take keys)."""
+        try:
+            self._store.delete_prefix(self._prefix())
+        except Exception:
+            pass
+
+
 class LinearBarrier:
     """Two-phase barrier with error propagation (reference
     dist_store.py:91-196). Leader waits for every rank to arrive, then
     signals departure. ``report_error`` poisons the barrier: all waiters
-    raise. Pure KV traffic — safe from non-main threads."""
+    raise. ``watchers`` are callables run every poll iteration that may
+    raise to abort the wait early (take-abort propagation). Pure KV
+    traffic — safe from non-main threads."""
 
     def __init__(
         self,
@@ -203,6 +350,7 @@ class LinearBarrier:
         world_size: int,
         leader_rank: int = 0,
         timeout_sec: float = _DEFAULT_TIMEOUT_SEC,
+        watchers: Optional[List[Callable[[], None]]] = None,
     ) -> None:
         self.store = store
         self.prefix = prefix
@@ -210,9 +358,32 @@ class LinearBarrier:
         self.world_size = world_size
         self.leader_rank = leader_rank
         self.timeout_sec = timeout_sec
+        self.watchers = list(watchers or [])
 
     def _key(self, *parts: str) -> str:
         return "/".join((self.prefix,) + parts)
+
+    def _raise_any_reported_error(self) -> None:
+        """One dir-get over the error prefix when the backend supports it
+        (coordination clients without a cheap single-key probe pay a
+        blocking-get timeout PER missing key — O(world_size) per poll
+        iteration scales badly); per-key scan as the fallback."""
+        prefix = self._key("error") + "/"
+        try:
+            errs = self.store.try_get_dir(prefix)
+        except Exception:
+            errs = None
+        if errs is None:
+            errs = {}
+            for r in range(self.world_size):
+                err = self.store.try_get(self._key("error", str(r)))
+                if err is not None:
+                    errs[str(r)] = err
+        for k, err in sorted(errs.items()):
+            rank = k.rsplit("/", 1)[-1]
+            raise LinearBarrierError(
+                f"Rank {rank} reported error: {pickle.loads(err)}"
+            )
 
     def _checked_get(self, key: str) -> bytes:
         """Wait for a key while also watching for reported errors."""
@@ -221,12 +392,9 @@ class LinearBarrier:
             value = self.store.try_get(key)
             if value is not None:
                 return value
-            for r in range(self.world_size):
-                err = self.store.try_get(self._key("error", str(r)))
-                if err is not None:
-                    raise LinearBarrierError(
-                        f"Rank {r} reported error: {pickle.loads(err)}"
-                    )
+            for watcher in self.watchers:
+                watcher()
+            self._raise_any_reported_error()
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"LinearBarrier {self.prefix!r}: timed out waiting for "

@@ -1,0 +1,298 @@
+"""Deterministic storage fault injection: seeded ``FaultPlan`` + a
+``StoragePlugin`` wrapper, exposed as ``chaos+<scheme>://`` URLs.
+
+The chaos layer sits UNDER the retry middleware
+(``Retrying(FaultInjection(real plugin))``), so injected faults exercise
+exactly the production retry/abort paths:
+
+- transient exceptions (``InjectedFaultError`` subclasses
+  ``ConnectionError`` → classified transient by every plugin);
+- injected per-op latency (seeded jitter);
+- torn writes: a failing ``write`` persists a seeded prefix of the
+  buffer through the real plugin before raising — the exact failure
+  whole-op retry and metadata-written-last commit exist to survive;
+- short reads: a failing ``read`` delivers a truncated buffer before
+  raising — discarded by the retry wrapper's fresh-ReadIO-per-attempt;
+- crash-after-op: SIGKILL the process after the Nth successful op of a
+  kind (crash-matrix windows inside storage I/O, no monkeypatching).
+
+Usage — no code changes needed, just the URL (and optionally a spec)::
+
+    Snapshot.take("chaos+fs:///tmp/snap", app_state,
+                  storage_options={"fault_plan": FaultPlan(seed=3,
+                                                           transient_per_op=1)})
+    # or via the environment, e.g. in an example/benchmark run:
+    #   TPUSNAP_FAULT_SPEC="seed=3,transient_per_op=1,latency_ms=2"
+
+Determinism: all randomness derives from ``FaultPlan.seed``; op indices
+are assigned in arrival order. Under concurrent scheduling the mapping
+of logical blobs to op indices can vary run to run, but the injected
+fault COUNT and shape per seed are fixed — which is what the chaos soak
+asserts convergence and integrity against.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+import signal
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from .io_types import ReadIO, StoragePlugin, WriteIO
+
+logger = logging.getLogger(__name__)
+
+_FAULT_SPEC_ENV_VAR = "TPUSNAP_FAULT_SPEC"
+
+
+class InjectedFaultError(ConnectionError):
+    """A deliberately injected transient storage failure. Subclasses
+    ``ConnectionError`` so every transient classifier retries it."""
+
+
+@dataclass
+class FaultPlan:
+    """Seeded, deterministic description of how a backend misbehaves.
+
+    - ``transient_per_op``: the first K attempts of every distinct
+      (kind, path) op raise transient errors — "≥1 transient error per
+      storage op" with guaranteed convergence under retry.
+    - ``transient_every``: additionally, every Nth op overall raises
+      (0 = off). Only FIRST attempts of an op can draw this fault
+      (retries are exempt, though they advance the counter), so any N —
+      including 1 — converges under retry.
+    - ``torn_writes``: failing writes persist a seeded prefix through
+      the real plugin before raising (object-store ``write_atomic``
+      failures stay clean: tearing there would fabricate a failure the
+      real backend cannot produce).
+    - ``short_reads``: failing reads deliver a seeded truncation of the
+      real bytes before raising.
+    - ``latency_sec``: seeded-jittered sleep on every op.
+    - ``crash_after_op``: ("write", 7) → SIGKILL this process right
+      after the 7th successful write (1-based).
+    """
+
+    seed: int = 0
+    transient_per_op: int = 0
+    transient_every: int = 0
+    torn_writes: bool = False
+    short_reads: bool = False
+    latency_sec: float = 0.0
+    crash_after_op: Optional[Tuple[str, int]] = None
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """Parse ``"seed=3,transient_per_op=1,latency_ms=2,torn_writes=1"``.
+        Keys mirror the field names; ``latency_ms`` is accepted as a
+        convenience; ``crash_after_op=write:7``."""
+        plan = cls()
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, _, value = item.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if key == "latency_ms":
+                plan.latency_sec = float(value) / 1000.0
+            elif key == "latency_sec":
+                plan.latency_sec = float(value)
+            elif key in ("seed", "transient_per_op", "transient_every"):
+                setattr(plan, key, int(value))
+            elif key in ("torn_writes", "short_reads"):
+                setattr(plan, key, value not in ("0", "false", "False", ""))
+            elif key == "crash_after_op":
+                kind, _, idx = value.partition(":")
+                plan.crash_after_op = (kind, int(idx))
+            else:
+                raise ValueError(f"Unknown fault spec key {key!r} in {spec!r}")
+        return plan
+
+    @classmethod
+    def coerce(cls, value) -> "FaultPlan":
+        """FaultPlan | spec-string | dict | None → FaultPlan. ``None``
+        consults TPUSNAP_FAULT_SPEC, defaulting to one transient error
+        per op (a chaos URL with no plan should still misbehave)."""
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, str):
+            return cls.from_spec(value)
+        if isinstance(value, dict):
+            return cls(**value)
+        if value is None:
+            env = os.environ.get(_FAULT_SPEC_ENV_VAR)
+            if env:
+                return cls.from_spec(env)
+            return cls(transient_per_op=1)
+        raise TypeError(f"Cannot build a FaultPlan from {value!r}")
+
+
+@dataclass
+class _FaultState:
+    """Mutable per-plugin-instance counters (the plan itself is data)."""
+
+    rng: random.Random
+    op_count: int = 0
+    kind_success: Dict[str, int] = field(default_factory=dict)
+    per_op_attempts: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+class FaultInjectionStoragePlugin(StoragePlugin):
+    """Wraps any ``StoragePlugin``, misbehaving per a seeded ``FaultPlan``.
+    Scheduling-transparent like the retry wrapper (in-place reads,
+    overhead accounting, draining all delegate)."""
+
+    def __init__(self, inner: StoragePlugin, plan: Optional[FaultPlan] = None):
+        self.inner = inner
+        self.plan = FaultPlan.coerce(plan)
+        self._state = _FaultState(rng=random.Random(self.plan.seed))
+
+    # --- scheduling transparency -----------------------------------------
+
+    @property
+    def supports_in_place_reads(self) -> bool:  # type: ignore[override]
+        return self.inner.supports_in_place_reads
+
+    def in_place_read_overhead_bytes(self, nbytes: int) -> int:
+        return self.inner.in_place_read_overhead_bytes(nbytes)
+
+    def drain_in_flight(self) -> None:
+        self.inner.drain_in_flight()
+
+    def classify_transient(self, exc: BaseException) -> bool:
+        # The retry wrapper asks the plugin it wraps; delegate to the
+        # real backend's classifier (InjectedFaultError is a
+        # ConnectionError, transient under every default).
+        from .retry import default_classify_transient
+
+        inner_classify = getattr(
+            self.inner, "classify_transient", default_classify_transient
+        )
+        return isinstance(exc, InjectedFaultError) or inner_classify(exc)
+
+    # --- fault decisions --------------------------------------------------
+
+    def _decide(self, kind: str, path: str) -> Tuple[bool, float]:
+        """One decision per op attempt: (inject_transient, latency)."""
+        plan, st = self.plan, self._state
+        with st.lock:
+            st.op_count += 1
+            n = st.op_count
+            latency = (
+                plan.latency_sec * (0.5 + st.rng.random())
+                if plan.latency_sec
+                else 0.0
+            )
+            inject = False
+            key = (kind, path)
+            attempts = st.per_op_attempts.get(key, 0)
+            st.per_op_attempts[key] = attempts + 1
+            if plan.transient_per_op and attempts < plan.transient_per_op:
+                inject = True
+            if (
+                plan.transient_every
+                and attempts == 0
+                and n % plan.transient_every == 0
+            ):
+                # First attempts only: a RETRY of an op that drew the
+                # every-Nth fault must not draw it again (with
+                # transient_every=1 every attempt would fault and the
+                # op could never converge under retry).
+                inject = True
+            return inject, latency
+
+    def _record_success(self, kind: str) -> None:
+        plan, st = self.plan, self._state
+        with st.lock:
+            st.kind_success[kind] = st.kind_success.get(kind, 0) + 1
+            crash = (
+                plan.crash_after_op is not None
+                and plan.crash_after_op[0] == kind
+                and st.kind_success[kind] == plan.crash_after_op[1]
+            )
+        if crash:
+            logger.warning(
+                "FaultPlan crash_after_op=%s: SIGKILLing pid %d",
+                plan.crash_after_op,
+                os.getpid(),
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def _torn_len(self, total: int) -> int:
+        with self._state.lock:
+            return self._state.rng.randrange(0, max(total, 1))
+
+    async def _pre(self, kind: str, path: str) -> bool:
+        """Apply latency; return whether this attempt must fail."""
+        inject, latency = self._decide(kind, path)
+        if latency:
+            await asyncio.sleep(latency)
+        return inject
+
+    # --- plugin interface -------------------------------------------------
+
+    async def write(self, write_io: WriteIO) -> None:
+        if await self._pre("write", write_io.path):
+            if self.plan.torn_writes and len(write_io.buf) > 0:
+                keep = self._torn_len(len(write_io.buf))
+                torn = memoryview(write_io.buf).cast("B")[:keep]
+                try:
+                    await self.inner.write(WriteIO(path=write_io.path, buf=torn))
+                except Exception:
+                    pass  # the torn write itself may fail; either way we raise
+                raise InjectedFaultError(
+                    f"injected torn write: {keep}/{len(write_io.buf)} bytes "
+                    f"of {write_io.path!r} persisted"
+                )
+            raise InjectedFaultError(f"injected write failure: {write_io.path!r}")
+        await self.inner.write(write_io)
+        self._record_success("write")
+
+    async def write_atomic(self, write_io: WriteIO, durable: bool = False) -> None:
+        if await self._pre("write_atomic", write_io.path):
+            # Never tear an atomic write: the wrapped plugin's contract is
+            # that a failed write_atomic leaves no trace, and chaos must
+            # not fabricate failures the real backend cannot produce.
+            raise InjectedFaultError(
+                f"injected write_atomic failure: {write_io.path!r}"
+            )
+        await self.inner.write_atomic(write_io, durable=durable)
+        self._record_success("write_atomic")
+
+    async def read(self, read_io: ReadIO) -> None:
+        if await self._pre("read", read_io.path):
+            if self.plan.short_reads:
+                # Deliver a seeded truncation of the real bytes, then fail
+                # the op — simulating a connection dropped mid-transfer.
+                trial = ReadIO(path=read_io.path, byte_range=read_io.byte_range)
+                try:
+                    await self.inner.read(trial)
+                    data = trial.buf.getvalue()
+                    import io as _io
+
+                    read_io.buf = _io.BytesIO(data[: self._torn_len(len(data))])
+                except Exception:
+                    pass
+                raise InjectedFaultError(
+                    f"injected short read: {read_io.path!r}"
+                )
+            raise InjectedFaultError(f"injected read failure: {read_io.path!r}")
+        await self.inner.read(read_io)
+        self._record_success("read")
+
+    async def delete(self, path: str) -> None:
+        if await self._pre("delete", path):
+            raise InjectedFaultError(f"injected delete failure: {path!r}")
+        await self.inner.delete(path)
+        self._record_success("delete")
+
+    async def flush_created_dirs(self) -> None:
+        await self.inner.flush_created_dirs()
+
+    async def close(self) -> None:
+        await self.inner.close()

@@ -45,16 +45,60 @@ def is_host_resident(arr: object) -> bool:
         return False
 
 
+def is_offloaded_to_host(arr: object) -> bool:
+    """True when the array lives in a host memory kind DISTINCT from its
+    device's default memory — i.e. genuinely offloaded. On CPU backends
+    whose only/default memory kind is a host kind, default-placed arrays
+    are host *resident* (``is_host_resident``) but not *offloaded*; the
+    distinction matters to callers deciding whether touching the device
+    would be a detour (the batcher's device-pack routing)."""
+    if not isinstance(arr, jax.Array):
+        return False
+    try:
+        kind = arr.sharding.memory_kind
+        if kind not in _HOST_MEMORY_KINDS:
+            return False
+        default = next(iter(arr.devices())).default_memory().kind
+        return kind != default
+    except Exception:
+        return False
+
+
 def to_host_offload(arr: jax.Array, memory_kind: str = "pinned_host") -> jax.Array:
     """Move an array to host memory, preserving its sharding layout
-    (reference ``new_managed_tensor``: allocate in UVM)."""
+    (reference ``new_managed_tensor``: allocate in UVM). If the device
+    does not expose the requested host kind, degrade to one it does
+    (CPU backends typically offer only ``unpinned_host``)."""
     if memory_kind not in _HOST_MEMORY_KINDS:
         raise ValueError(f"not a host memory kind: {memory_kind!r}")
+    try:
+        available = {
+            m.kind for m in next(iter(arr.devices())).addressable_memories()
+        } & _HOST_MEMORY_KINDS
+    except Exception:
+        available = set()
+    if available and memory_kind not in available:
+        fallback = (
+            "pinned_host" if "pinned_host" in available else sorted(available)[0]
+        )
+        logger.debug(
+            "Host memory kind %r unavailable on this backend; using %r",
+            memory_kind,
+            fallback,
+        )
+        memory_kind = fallback
     sharding = arr.sharding.with_memory_kind(memory_kind)
     return jax.device_put(arr, sharding)
 
 
 def to_device(arr: jax.Array) -> jax.Array:
-    """Move a host-offloaded array back to device HBM."""
-    sharding = arr.sharding.with_memory_kind("device")
+    """Move a host-offloaded array back to the device's DEFAULT memory
+    ("device" HBM on TPU/GPU; on CPU backends whose only memory kind is
+    unpinned_host, the default IS host memory and this is a no-op —
+    hardcoding "device" raises there)."""
+    try:
+        default_kind = next(iter(arr.devices())).default_memory().kind
+    except Exception:
+        default_kind = "device"
+    sharding = arr.sharding.with_memory_kind(default_kind)
     return jax.device_put(arr, sharding)

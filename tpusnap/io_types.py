@@ -139,6 +139,23 @@ class StoragePlugin(abc.ABC):
     # only the plugin's transient overhead instead of the blob size.
     supports_in_place_reads: bool = False
 
+    # Middleware markers consulted by the scheme registry
+    # (storage_plugin.url_to_storage_plugin): ``wants_retry_middleware``
+    # opts the plugin into the unified whole-op retry wrapper
+    # (tpusnap.retry); ``handles_own_retries`` marks plugins with
+    # internal, finer-grained retry logic (gcs retries per chunk) that
+    # must not be double-wrapped.
+    wants_retry_middleware: bool = False
+    handles_own_retries: bool = False
+
+    def classify_transient(self, exc: BaseException) -> bool:
+        """Whether ``exc`` from this backend is worth retrying. The
+        retry middleware consults this; plugins override to recognize
+        backend-specific throttle/timeout shapes."""
+        from .retry import default_classify_transient
+
+        return default_classify_transient(exc)
+
     def in_place_read_overhead_bytes(self, nbytes: int) -> int:
         """Peak transient scratch memory an in-place read of ``nbytes``
         allocates inside this plugin (drives the scheduler's consuming

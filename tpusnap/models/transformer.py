@@ -39,6 +39,13 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.flash_attention import flash_attention
+
+# jax.shard_map was promoted to the top-level namespace in newer JAX;
+# older versions expose it under jax.experimental.shard_map.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
 from ..ops.ring_attention import ring_attention
 
 Params = Dict[str, Any]
@@ -191,7 +198,7 @@ class Transformer:
             # Heads are independent (no collective on "tensor"); K/V blocks
             # rotate over the "fsdp" ring.
             spec = P("data", "fsdp", "tensor", None)
-            out = jax.shard_map(
+            out = _shard_map(
                 functools.partial(ring_attention, axis_name="fsdp", causal=True),
                 mesh=mesh,
                 in_specs=(spec, spec, spec),

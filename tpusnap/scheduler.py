@@ -379,16 +379,14 @@ async def execute_write_reqs(
         while pipelines and len(staging_tasks) < _MAX_CPU_CONCURRENCY:
             head = pipelines[0]
             # The ≥1 over-budget admission may only fire when NOTHING
-            # can free budget: under staging priority, staged buffers
-            # waiting in ready_for_io count — admitting over budget
-            # past them would hold every staged buffer resident and
-            # unenforce the budget entirely (the I/O gate below opens
-            # exactly when we break here with no staging in flight).
-            in_flight = (
-                staging_tasks
-                or io_tasks
-                or (prioritize_staging and ready_for_io)
-            )
+            # can free budget: staged buffers waiting in ready_for_io
+            # count in EVERY mode — they hold budget that the write
+            # dispatched on the next loop turn will credit back.
+            # Admitting over budget past them held every staged buffer
+            # resident at once (observed as peak 3/2 budget whenever all
+            # in-flight stagings completed in one wait batch before any
+            # I/O was dispatched) and unenforced the budget entirely.
+            in_flight = staging_tasks or io_tasks or ready_for_io
             if head.staging_cost > budget and in_flight:
                 break  # wait for memory to free up
             pipelines.popleft()

@@ -43,7 +43,14 @@ from typing import Any, Dict, List, Optional, Set
 import jax
 
 from .comm import Communicator, get_communicator
-from .dist_store import CoordinationKVStore, KVStore, LinearBarrier, MemoryKVStore
+from .dist_store import (
+    CoordinationKVStore,
+    KVStore,
+    LinearBarrier,
+    MemoryKVStore,
+    TakeAbortedError,
+    TakeAbortMonitor,
+)
 from .flatten import flatten, inflate
 from .io_preparer import prepare_read, prepare_write
 from .io_types import ReadIO, StoragePlugin, WriteIO
@@ -215,6 +222,8 @@ class Snapshot:
         default skips the barriers (and their extra key gather)."""
         comm = get_communicator(comm)
         event_loop = asyncio.new_event_loop()
+        abort_ctx = _TakeAbortContext(comm)
+        abort_ctx.event_loop = event_loop
         try:
             pending_io_work, metadata, path, storage, late_checksums = _take_impl(
                 path=path,
@@ -227,6 +236,7 @@ class Snapshot:
                 per_key_barrier=per_key_barrier,
                 array_prepare_func=_custom_array_prepare_func,
                 incremental_from=incremental_from,
+                abort_ctx=abort_ctx,
             )
             pending_io_work.sync_complete(event_loop)
             from .knobs import is_durable_commit_enabled
@@ -241,14 +251,25 @@ class Snapshot:
                 # final — publish before the barrier; rank 0 applies
                 # after it (every rank arrived ⟹ every rank published).
                 late_checksums.publish()
+            # With the abort watcher armed (multi-process), both commit
+            # barriers poll for peer abort records and raise
+            # TakeAbortedError within seconds instead of burning the
+            # full barrier timeout on a failed rank.
             comm.barrier()
             if comm.rank == 0:
                 if late_checksums is not None:
                     late_checksums.apply(metadata.manifest)
+                abort_ctx.mark_commit_started()
                 _write_metadata(storage, metadata, event_loop)
             comm.barrier()
+            if comm.rank == 0 and abort_ctx.monitor is not None:
+                abort_ctx.monitor.clear()
             storage.sync_close(event_loop)
+        except BaseException as e:
+            abort_ctx.on_failure(e)
+            raise
         finally:
+            abort_ctx.disarm()
             event_loop.close()
         snapshot = cls(path, storage_options, comm)
         if comm.rank == 0 or late_checksums is None:
@@ -272,30 +293,40 @@ class Snapshot:
     ) -> "PendingSnapshot":
         comm = get_communicator(comm)
         event_loop = asyncio.new_event_loop()
-        pending_io_work, metadata, path, storage, late_checksums = _take_impl(
-            path=path,
-            app_state=app_state,
-            storage_options=storage_options,
-            comm=comm,
-            replicated=replicated or [],
-            event_loop=event_loop,
-            is_async_snapshot=True,
-            per_key_barrier=per_key_barrier,
-            array_prepare_func=_custom_array_prepare_func,
-            incremental_from=incremental_from,
-        )
-        # Control returns to training here: staging is complete, the
-        # snapshot content is frozen; only storage I/O remains.
-        return PendingSnapshot(
-            path=path,
-            pending_io_work=pending_io_work,
-            metadata=metadata,
-            storage=storage,
-            comm=comm,
-            event_loop=event_loop,
-            storage_options=storage_options,
-            late_checksums=late_checksums,
-        )
+        abort_ctx = _TakeAbortContext(comm)
+        abort_ctx.event_loop = event_loop
+        try:
+            pending_io_work, metadata, path, storage, late_checksums = _take_impl(
+                path=path,
+                app_state=app_state,
+                storage_options=storage_options,
+                comm=comm,
+                replicated=replicated or [],
+                event_loop=event_loop,
+                is_async_snapshot=True,
+                per_key_barrier=per_key_barrier,
+                array_prepare_func=_custom_array_prepare_func,
+                incremental_from=incremental_from,
+                abort_ctx=abort_ctx,
+            )
+            # Control returns to training here: staging is complete, the
+            # snapshot content is frozen; only storage I/O remains.
+            return PendingSnapshot(
+                path=path,
+                pending_io_work=pending_io_work,
+                metadata=metadata,
+                storage=storage,
+                comm=comm,
+                event_loop=event_loop,
+                storage_options=storage_options,
+                late_checksums=late_checksums,
+                abort_ctx=abort_ctx,
+            )
+        except BaseException as e:
+            abort_ctx.on_failure(e)
+            abort_ctx.disarm()
+            event_loop.close()
+            raise
 
     # --------------------------------------------------------------- restore
 
@@ -511,6 +542,72 @@ class Snapshot:
 # ---------------------------------------------------------------- internals
 
 
+class _TakeAbortContext:
+    """Failure-path bookkeeping for one take.
+
+    Armed (multi-process) once G1 agrees the take_id: installs the
+    :class:`TakeAbortMonitor` as the communicator's wait watcher, so
+    every subsequent collective wait and commit barrier raises
+    :class:`TakeAbortedError` within seconds of any rank's failure
+    instead of burning the barrier timeout. On failure it publishes this
+    rank's abort record, best-effort deletes the blobs this rank staged
+    (so the path stays reusable and aborted takes leave no orphan
+    storage), and drops this rank's late-checksum blob. Blob deletion is
+    suppressed once the metadata commit may have started — orphan blobs
+    are safe, dangling manifest references are not (the
+    metadata-written-last ⟺ restorable invariant)."""
+
+    def __init__(self, comm: Communicator) -> None:
+        self.comm = comm
+        self.monitor: Optional[TakeAbortMonitor] = None
+        self.storage: Optional[StoragePlugin] = None
+        self.event_loop: Optional[asyncio.AbstractEventLoop] = None
+        self.write_paths: List[str] = []
+        self.late_checksums: Optional["_LateChecksums"] = None
+        self.commit_started = False
+
+    def arm(self, monitor: TakeAbortMonitor) -> None:
+        self.monitor = monitor
+        self.comm.set_wait_watcher(monitor.check)
+
+    def disarm(self) -> None:
+        if self.monitor is not None:
+            self.comm.clear_wait_watcher()
+
+    def mark_commit_started(self) -> None:
+        self.commit_started = True
+        if self.monitor is not None:
+            self.monitor.mark_commit_started()
+
+    def on_failure(self, exc: BaseException) -> None:
+        """Publish + clean up; never raises."""
+        if self.monitor is not None and not isinstance(exc, TakeAbortedError):
+            self.monitor.publish(exc)
+        keep_blobs = self.commit_started or (
+            self.monitor is not None and self.monitor.commit_may_have_started()
+        )
+        if (
+            not keep_blobs
+            and self.storage is not None
+            and self.event_loop is not None
+        ):
+            for path in self.write_paths:
+                try:
+                    self.storage.sync_delete(path, self.event_loop)
+                except Exception:
+                    pass
+        if self.late_checksums is not None:
+            try:
+                self.late_checksums.discard()
+            except Exception:
+                pass
+        if self.storage is not None and self.event_loop is not None:
+            try:
+                self.storage.sync_close(self.event_loop)
+            except Exception:
+                pass
+
+
 def _validate_app_state(app_state: AppState) -> None:
     for key, stateful in app_state.items():
         if not (hasattr(stateful, "state_dict") and hasattr(stateful, "load_state_dict")):
@@ -541,6 +638,7 @@ def _take_impl(
     per_key_barrier: bool = False,
     array_prepare_func: Optional[Any] = None,
     incremental_from: Optional[str] = None,
+    abort_ctx: Optional["_TakeAbortContext"] = None,
 ):
     """Core take flow. Exactly TWO all-gathers in the default
     multi-process path (the reference issues ~6 collectives,
@@ -666,6 +764,14 @@ def _take_impl(
             1 for g in gathered if g["hostname"] == my_host
         )
         traced_geometry = traced_map
+        if abort_ctx is not None:
+            # take_id is agreed: arm distributed abort propagation. From
+            # here every collective wait in this take (the G2 gather's
+            # barrier, the commit barriers/broadcasts) polls for peer
+            # abort records and raises TakeAbortedError within seconds.
+            abort_ctx.arm(
+                TakeAbortMonitor(_get_kv_store(comm), take_id, rank)
+            )
     else:
         replicated_paths = matched
         traced_geometry = {}
@@ -673,6 +779,8 @@ def _take_impl(
     storage = url_to_storage_plugin_in_event_loop(
         path, event_loop, storage_options
     )
+    if abort_ctx is not None:
+        abort_ctx.storage = storage
 
     # Incremental snapshot: this rank's view of the base snapshot's
     # manifest, blob locations rewritten relative to the NEW root.
@@ -744,6 +852,12 @@ def _take_impl(
     entries_list = list(entries.values())
     entries_list, write_reqs = batch_write_requests(entries_list, write_reqs)
     entries = dict(zip(entries.keys(), entries_list))
+    if abort_ctx is not None:
+        # The final set of blob paths this rank may write — an aborting
+        # take best-effort deletes them so the path stays reusable
+        # (dedup-skipped paths are never written; deleting them is a
+        # harmless no-op failure).
+        abort_ctx.write_paths = [wr.path for wr in write_reqs]
 
     # Non-incremental takes hash on the WRITE path instead of the
     # staging window (see ArrayBufferStager.defer_checksums) — the hash
@@ -767,6 +881,8 @@ def _take_impl(
                 deferred.append(wr.buffer_stager)
         if multi:
             late_checksums = _LateChecksums(comm, take_id, deferred)
+            if abort_ctx is not None:
+                abort_ctx.late_checksums = late_checksums
 
     memory_budget = get_process_memory_budget_bytes(
         comm, local_world_size=local_world_size
@@ -1063,6 +1179,18 @@ class _LateChecksums:
     def _prefix(self) -> str:
         return f"tpusnap_late_cs/{self.take_id}/"
 
+    def discard(self) -> None:
+        """Abort path: best-effort removal of this rank's published blob
+        — the commit that would have consumed and deleted it will never
+        run, and the coordination service must not accumulate one blob
+        per rank per aborted take."""
+        if not self.active:
+            return
+        try:
+            _get_kv_store(self.comm).delete_prefix(self._key(self.comm.rank))
+        except Exception:
+            pass
+
     def apply(self, manifest: Manifest) -> None:
         """Leader-only: patch + clean up. Callers hold proof every rank
         published (all ranks arrived at the commit barrier)."""
@@ -1325,6 +1453,7 @@ class PendingSnapshot(_BackgroundWork):
         event_loop: asyncio.AbstractEventLoop,
         storage_options: Optional[Dict[str, Any]] = None,
         late_checksums: Optional["_LateChecksums"] = None,
+        abort_ctx: Optional["_TakeAbortContext"] = None,
     ) -> None:
         self.path = path
         self._pending_io_work = pending_io_work
@@ -1334,6 +1463,7 @@ class PendingSnapshot(_BackgroundWork):
         self._event_loop = event_loop
         self._storage_options = storage_options
         self._late_checksums = late_checksums
+        self._abort_ctx = abort_ctx
         self._snapshot: Optional[Snapshot] = None
 
         # Barrier identity must be agreed on the MAIN thread (this may
@@ -1344,13 +1474,23 @@ class PendingSnapshot(_BackgroundWork):
         # everything pending NOW; collectives the main thread issues
         # later (a newer take on the same communicator) stay pending.
         self._gc_epoch = comm.gc_epoch()
+        monitor = abort_ctx.monitor if abort_ctx is not None else None
         self._barrier = LinearBarrier(
             store=_get_kv_store(comm),
             prefix=barrier_prefix,
             rank=comm.rank,
             world_size=comm.world_size,
             timeout_sec=self.BARRIER_TIMEOUT_SEC,
+            # Peer abort records surface as TakeAbortedError from the
+            # background commit's barrier waits within seconds.
+            watchers=[monitor.check] if monitor is not None else None,
         )
+        # The main thread is done with collectives for this take; free
+        # the communicator's wait watcher for any newer take. The
+        # background commit keeps abort awareness via the barrier
+        # watcher above.
+        if abort_ctx is not None:
+            abort_ctx.disarm()
         self._start()
 
     def _body(self) -> None:
@@ -1373,8 +1513,16 @@ class PendingSnapshot(_BackgroundWork):
             # delete the keys, commit.
             if self._late_checksums is not None:
                 self._late_checksums.apply(self._metadata.manifest)
+            if self._abort_ctx is not None:
+                self._abort_ctx.mark_commit_started()
             _write_metadata(self._storage, self._metadata, self._event_loop)
         self._barrier.depart()
+        if (
+            self._comm.rank == 0
+            and self._abort_ctx is not None
+            and self._abort_ctx.monitor is not None
+        ):
+            self._abort_ctx.monitor.clear()
         # Every rank departing proves it consumed the take's gathers
         # and the barrier-prefix broadcast; release their KV keys now
         # — no further barrier will run on this communicator, so the
@@ -1395,8 +1543,16 @@ class PendingSnapshot(_BackgroundWork):
         self._snapshot = snapshot
 
     def _on_error(self, exc: BaseException) -> None:
-        # Poison the barrier so every rank's wait() re-raises and the
-        # metadata is never written.
+        # Publish this rank's abort record (peers' barrier watchers then
+        # raise TakeAbortedError) and best-effort delete its staged
+        # blobs; the metadata is never written. Without a monitor
+        # (single-process, or explicit comm without abort context), fall
+        # back to poisoning the barrier the classic way.
+        ctx = self._abort_ctx
+        if ctx is not None:
+            ctx.on_failure(exc)
+            if ctx.monitor is not None:
+                return
         self._barrier.report_error(exc)
 
     def _cleanup(self) -> None:

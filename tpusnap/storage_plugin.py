@@ -4,6 +4,20 @@ Counterpart of /root/reference/torchsnapshot/storage_plugin.py:18-70.
 Built-ins: fs (default), s3, gs/gcs, and a generic fsspec bridge
 (``fsspec+<protocol>://``). Third-party plugins register through the
 ``tpusnap.storage_plugins`` entry-point group.
+
+Two middleware layers compose around the raw plugin:
+
+- ``chaos+<scheme>://`` wraps the resolved plugin in deterministic fault
+  injection (``tpusnap.faults``) — any test, example or benchmark runs
+  against a misbehaving backend with only a URL change
+  (``storage_options["fault_plan"]`` or TPUSNAP_FAULT_SPEC tune it).
+- Retry middleware (``tpusnap.retry``) wraps built-in plugins that do
+  not handle their own retries (fs, s3, fsspec — gcs retries internally
+  at chunk grain, which is strictly finer). Disable per call with
+  ``storage_options={"retry": False}``; tune via the ``retry_*`` keys.
+  Runtime-registered and entry-point plugins are returned as built —
+  their factories opt in by wrapping with ``RetryingStoragePlugin``
+  themselves.
 """
 
 import asyncio
@@ -13,6 +27,8 @@ from typing import Any, Dict, Optional
 from .io_types import StoragePlugin
 
 _ENTRY_POINT_GROUP = "tpusnap.storage_plugins"
+
+_CHAOS_PREFIX = "chaos+"
 
 # scheme → factory(path, storage_options) registered at runtime; consulted
 # before entry points so tests/apps can inject plugins without packaging.
@@ -33,16 +49,10 @@ def unregister_storage_plugin(scheme: str) -> None:
     _RUNTIME_REGISTRY.pop(scheme.lower(), None)
 
 
-def url_to_storage_plugin(
-    url_path: str, storage_options: Optional[Dict[str, Any]] = None
+def _resolve_raw_plugin(
+    scheme: str, path: str, storage_options: Optional[Dict[str, Any]]
 ) -> StoragePlugin:
-    """Map ``[scheme://]path`` to a storage plugin instance."""
-    if "://" in url_path:
-        scheme, path = url_path.split("://", 1)
-    else:
-        scheme, path = "fs", url_path
-    scheme = scheme.lower()
-
+    """Map a (chaos-stripped) scheme to a plugin instance, middleware-free."""
     if scheme in _RUNTIME_REGISTRY:
         return _RUNTIME_REGISTRY[scheme](path, storage_options)
     if scheme in ("", "fs", "file"):
@@ -73,7 +83,52 @@ def url_to_storage_plugin(
         if ep.name == scheme:
             factory = ep.load()
             return factory(path, storage_options)
-    raise RuntimeError(f"Unsupported storage scheme: {scheme}:// ({url_path})")
+    raise RuntimeError(f"Unsupported storage scheme: {scheme}:// ({path})")
+
+
+def url_to_storage_plugin(
+    url_path: str, storage_options: Optional[Dict[str, Any]] = None
+) -> StoragePlugin:
+    """Map ``[scheme://]path`` to a storage plugin instance, composing the
+    chaos and retry middleware layers as the scheme/options direct."""
+    if "://" in url_path:
+        scheme, path = url_path.split("://", 1)
+    else:
+        scheme, path = "fs", url_path
+    scheme = scheme.lower()
+
+    chaos = scheme.startswith(_CHAOS_PREFIX)
+    if chaos:
+        scheme = scheme[len(_CHAOS_PREFIX) :] or "fs"
+
+    # Runtime-registered factories own their composition: what they
+    # return is what callers get (tests register exact plugin doubles).
+    # The chaos layer still composes around them — that is its point.
+    from_runtime_registry = scheme in _RUNTIME_REGISTRY
+    plugin = _resolve_raw_plugin(scheme, path, storage_options)
+
+    if chaos:
+        from .faults import FaultInjectionStoragePlugin, FaultPlan
+
+        plan = FaultPlan.coerce((storage_options or {}).get("fault_plan"))
+        plugin = FaultInjectionStoragePlugin(plugin, plan)
+
+    wants_retry = chaos or (
+        not from_runtime_registry
+        and getattr(plugin, "wants_retry_middleware", False)
+    )
+    retry_enabled = (storage_options or {}).get("retry", True)
+    if (
+        wants_retry
+        and retry_enabled
+        and not getattr(plugin, "handles_own_retries", False)
+    ):
+        from .retry import RetryPolicy, RetryingStoragePlugin
+
+        plugin = RetryingStoragePlugin(
+            plugin, RetryPolicy.from_storage_options(storage_options)
+        )
+    return plugin
 
 
 def url_to_storage_plugin_in_event_loop(

@@ -14,7 +14,10 @@ import pathlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Set
 
-import aiofiles
+try:
+    import aiofiles
+except ModuleNotFoundError:  # gated dep: fall back to thread-pool I/O
+    aiofiles = None
 import numpy as np
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
@@ -27,6 +30,11 @@ _NATIVE_WRITE_THRESHOLD = 4 * 1024 * 1024
 
 class FSStoragePlugin(StoragePlugin):
     supports_in_place_reads = True
+    # Whole-op retry middleware (tpusnap.retry) wraps this plugin when it
+    # is built from a URL: local filesystems rarely throw transient
+    # errors, but network mounts (NFS/FUSE) and chaos runs do, and the
+    # default errno/connection classifier covers both.
+    wants_retry_middleware = True
 
     def in_place_read_overhead_bytes(self, nbytes: int) -> int:
         """Per-stream bounce memory of the native in-place read engine
@@ -62,9 +70,10 @@ class FSStoragePlugin(StoragePlugin):
         path = pathlib.Path(os.path.join(self.root, write_io.path))
         self._ensure_parent(path)
         buf = write_io.buf
-        if len(buf) >= _NATIVE_WRITE_THRESHOLD:
+        if len(buf) >= _NATIVE_WRITE_THRESHOLD or aiofiles is None:
             # One blocking write in a thread: releases the GIL for the whole
-            # transfer and avoids aiofiles' per-chunk hop overhead.
+            # transfer and avoids aiofiles' per-chunk hop overhead. Also the
+            # small-write path when aiofiles is not installed.
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(self._get_executor(), _write_file, path, buf)
         else:
@@ -138,6 +147,18 @@ class FSStoragePlugin(StoragePlugin):
             return
         if n >= _NATIVE_WRITE_THRESHOLD:
             read_io.buf = await self._native_read(path, offset, n, read_io)
+            return
+        if aiofiles is None:
+
+            def work():
+                with open(path, "rb") as f:
+                    if offset:
+                        f.seek(offset)
+                    return f.read(n)
+
+            loop = asyncio.get_running_loop()
+            data = await loop.run_in_executor(self._get_executor(), work)
+            read_io.buf = io.BytesIO(data)
             return
         async with aiofiles.open(path, "rb") as f:
             if offset:

@@ -15,6 +15,12 @@ from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 
 class FsspecStoragePlugin(StoragePlugin):
+    # Wrapped in the whole-op retry middleware when built from a URL:
+    # fsspec backends span everything from in-memory dicts to SFTP — the
+    # default connection/timeout/errno classifier is the right generic
+    # net for them.
+    wants_retry_middleware = True
+
     def __init__(
         self,
         protocol: str,

@@ -16,6 +16,37 @@ from ..memoryview_stream import MemoryviewStream
 
 class S3StoragePlugin(StoragePlugin):
     supports_in_place_reads = True
+    # Wrapped in the whole-op retry middleware when built from a URL
+    # (S3 PUTs are per-object atomic, so whole-op retry is torn-write
+    # safe by construction).
+    wants_retry_middleware = True
+
+    # S3 error codes that mean "back off and try again" even when the
+    # HTTP status alone is ambiguous.
+    _TRANSIENT_ERROR_CODES = frozenset(
+        {
+            "SlowDown",
+            "InternalError",
+            "RequestTimeout",
+            "RequestTimeoutException",
+            "Throttling",
+            "ThrottlingException",
+            "ServiceUnavailable",
+        }
+    )
+
+    def classify_transient(self, exc: BaseException) -> bool:
+        from ..retry import default_classify_transient
+
+        if default_classify_transient(exc):
+            return True
+        # botocore ClientError shape, sniffed without importing botocore.
+        response = getattr(exc, "response", None)
+        if isinstance(response, dict):
+            code = (response.get("Error") or {}).get("Code")
+            if code in self._TRANSIENT_ERROR_CODES:
+                return True
+        return False
 
     def __init__(
         self, root: str, storage_options: Optional[Dict[str, Any]] = None

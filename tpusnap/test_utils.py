@@ -103,7 +103,12 @@ def apply_platform_env() -> None:
         os.environ.get("XLA_FLAGS", ""),
     )
     if match:
-        jax.config.update("jax_num_cpu_devices", int(match.group(1)))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(match.group(1)))
+        except AttributeError:
+            # Older JAX: the XLA_FLAGS env var itself is honored at
+            # backend init, no config option needed.
+            pass
 
 
 def run_subprocess_world(
