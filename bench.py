@@ -219,7 +219,9 @@ def main() -> None:
                 for f in _glob.glob(
                     os.path.join(snap_path, "**", "*"), recursive=True
                 )
-                if os.path.isfile(f) and not f.endswith(".snapshot_metadata")
+                if os.path.isfile(f)
+                and not f.endswith(".snapshot_metadata")
+                and ".tpusnap" not in f.split(os.sep)
             ]
             sizes = {f: os.path.getsize(f) for f in files}
             total = sum(sizes.values())
@@ -363,12 +365,14 @@ def main() -> None:
         # (host contention), so roofline and take are sampled INTERLEAVED —
         # comparing a lucky roofline window against an unlucky take window
         # would say "pipeline overhead" where there is only disk noise.
+        from tpusnap import telemetry as _tele
         from tpusnap.rss_profiler import measure_rss_deltas
 
         times = []
         splits = []
         rooflines = []
         take_fracs = []
+        take_summaries = []
         budget_bytes = None
         for run in range(N_TAKE_RUNS):
             rl = measure_roofline(bench_root, per_array, N_ARRAYS)
@@ -391,6 +395,7 @@ def main() -> None:
             splits.append(
                 (stats.get("staging_s"), stats.get("total_s"))
             )
+            take_summaries.append(_tele.LAST_TAKE_SUMMARY)
             if run + 1 < N_TAKE_RUNS:
                 shutil.rmtree(tmp, ignore_errors=True)
         best_i = min(range(len(times)), key=times.__getitem__)
@@ -398,6 +403,30 @@ def main() -> None:
         gbps = nbytes / best / 1e9
         staging_s, sched_total_s = splits[best_i]
         roofline = max(rooflines)
+        # Per-stage telemetry of the BEST take (tpusnap.telemetry): the
+        # phase decomposition that makes the headline number diagnosable
+        # — where the wall-clock went, not just how long it was.
+        best_summary = take_summaries[best_i] or {}
+        stage_breakdown = {
+            "phases_s": {
+                k: round(v, 3)
+                for k, v in (best_summary.get("phases") or {}).items()
+            },
+            "phase_coverage": best_summary.get("phase_coverage"),
+            "counters": {
+                k: v
+                for k, v in (best_summary.get("counters") or {}).items()
+                if not k.startswith("staging_pool.")
+            },
+            "budget_high_water_gb": (
+                round(
+                    best_summary["gauges"]["scheduler.budget_used_bytes"] / 1e9, 2
+                )
+                if "scheduler.budget_used_bytes"
+                in (best_summary.get("gauges") or {})
+                else None
+            ),
+        }
 
         # Async-take leg at bench scale: the blocked window (under
         # staging-priority scheduling this is the defensive-clone pass)
@@ -696,6 +725,7 @@ def main() -> None:
                 ],
                 "roofline_runs_gbps": [round(r, 3) for r in rooflines],
                 "take_runs_s": [round(t, 2) for t in times],
+                "stage_breakdown": stage_breakdown,
                 "staging_s": round(staging_s, 2) if staging_s else None,
                 "residual_io_s": (
                     round(sched_total_s - staging_s, 2)

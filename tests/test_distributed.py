@@ -605,6 +605,8 @@ def _world_tile_grain_incremental(snap_dir):
         # repl rewrote whole (tile route off for multi replicated).
         total = 0
         for dirpath, _, files in os.walk(f"{snap_dir}/s1"):
+            if ".tpusnap" in dirpath.split(os.sep):
+                continue
             for f in files:
                 if f != ".snapshot_metadata":
                     total += os.path.getsize(os.path.join(dirpath, f))

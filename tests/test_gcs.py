@@ -470,10 +470,18 @@ def test_incremental_snapshot_through_gcs(fake_gcs, monkeypatch):
             {"s": state},
             incremental_from="gs://bkt/snaps/s0",
         )
-    # Only s1's metadata was uploaded; w deduped against s0's blob.
-    new = {k for k in fake_gcs.objects if "snaps/s1" in k}
+    # Only s1's metadata (plus the telemetry sidecar) was uploaded; w
+    # deduped against s0's blob — no payload bytes moved.
+    new = {
+        k
+        for k in fake_gcs.objects
+        if "snaps/s1" in k and ".tpusnap/" not in k
+    }
     assert new == {"snaps/s1/.snapshot_metadata"}, new
-    assert len(fake_gcs.objects) == n_before + 1
+    n_sidecars = sum(
+        1 for k in fake_gcs.objects if "snaps/s1" in k and ".tpusnap/" in k
+    )
+    assert len(fake_gcs.objects) == n_before + 1 + n_sidecars
     target = StateDict(w=np.zeros(8192, dtype=np.float32), step=0)
     Snapshot("gs://bkt/snaps/s1", storage_options=opts).restore({"s": target})
     assert np.array_equal(target["w"], state["w"]) and target["step"] == 1

@@ -30,9 +30,12 @@ from tpusnap.knobs import (
 
 
 def _blob_files(root: str):
-    """All files under a snapshot dir except the metadata."""
+    """All PAYLOAD files under a snapshot dir: everything except the
+    metadata and the .tpusnap/ sidecar (telemetry traces)."""
     out = []
     for dirpath, _, files in os.walk(root):
+        if ".tpusnap" in dirpath.split(os.sep):
+            continue
         for f in files:
             if f != ".snapshot_metadata":
                 out.append(os.path.relpath(os.path.join(dirpath, f), root))

@@ -33,8 +33,16 @@ try:  # pragma: no cover - import surface grows as modules land
     from .dist_store import TakeAbortedError  # noqa: F401
     from .retry import RetryPolicy  # noqa: F401
     from .faults import FaultPlan, InjectedFaultError  # noqa: F401
+    from .telemetry import (  # noqa: F401
+        MetricsSink,
+        register_metrics_sink,
+        unregister_metrics_sink,
+    )
 
     __all__ += [
+        "MetricsSink",
+        "register_metrics_sink",
+        "unregister_metrics_sink",
         "ScrubReport",
         "verify_snapshot",
         "TakeAbortedError",

@@ -19,9 +19,16 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
   retain ROOT --keep N  keep the newest N snapshots under ROOT; any kept
                         increment referencing a doomed base is
                         materialized first, then the rest are deleted
+  trace       PATH      render the take's telemetry (per-stage timings,
+                        counters, cross-rank rollup) from the traces
+                        persisted under .tpusnap/telemetry/ and the
+                        metadata extras (``--json`` for machines,
+                        ``--rank K`` for one rank's stage detail; exit
+                        3 = no telemetry recorded)
 
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
-(or provably-different diff), 3 undecidable/unverifiable.
+(or provably-different diff), 3 undecidable/unverifiable (or no
+telemetry recorded).
 """
 
 from __future__ import annotations
@@ -212,6 +219,109 @@ def cmd_retain(args) -> int:
     return 0
 
 
+def _fmt_seconds(s) -> str:
+    if s is None:
+        return "-"
+    if s >= 1.0:
+        return f"{s:.2f}s"
+    return f"{s * 1e3:.1f}ms"
+
+
+def cmd_trace(args) -> int:
+    import json as _json
+
+    from .io_types import ReadIO
+    from .telemetry import rollup_summaries, telemetry_rank_path
+
+    snap = Snapshot(args.path)
+    md = snap.metadata
+    rollup = (md.extras or {}).get("telemetry")
+    ranks: dict = {}
+    with snap._op_lock:
+        event_loop, storage = snap._resources()
+        for rank in range(md.world_size):
+            read_io = ReadIO(path=telemetry_rank_path(rank))
+            try:
+                storage.sync_read(read_io, event_loop)
+                ranks[rank] = _json.loads(read_io.buf.getvalue().decode("utf-8"))
+            except Exception:
+                continue  # telemetry disabled on this rank, or pre-telemetry snapshot
+    summaries = {r: d.get("summary") or {} for r, d in ranks.items()}
+    if rollup is None and summaries:
+        rollup = rollup_summaries(list(summaries.values()))
+    if not rollup and not summaries:
+        print(
+            "no telemetry recorded (taken with TPUSNAP_TELEMETRY=0, or a "
+            "pre-telemetry snapshot)",
+            file=sys.stderr,
+        )
+        return 3
+
+    if args.json:
+        print(
+            _json.dumps(
+                {
+                    "path": args.path,
+                    "world_size": md.world_size,
+                    "rollup": rollup,
+                    "ranks": {str(r): s for r, s in sorted(summaries.items())},
+                }
+            )
+        )
+        return 0
+
+    print(f"path:         {args.path}")
+    print(f"world_size:   {md.world_size}")
+    print(f"traced ranks: {sorted(ranks) if ranks else '(rollup only)'}")
+    if rollup:
+        print(f"take wall-clock (slowest rank): {_fmt_seconds(rollup.get('take_wall_s'))}")
+        cov = rollup.get("phase_coverage_min")
+        if cov is not None:
+            print(f"phase coverage of wall-clock:   {cov * 100:.1f}%")
+        stages = rollup.get("stages") or {}
+        if stages:
+            print(f"\n{'stage':<24s} {'ranks':>5s} {'p50':>10s} {'max':>10s}")
+            for name, agg in stages.items():
+                print(
+                    f"{name:<24s} {agg.get('ranks', 0):>5d} "
+                    f"{_fmt_seconds(agg.get('p50_s')):>10s} "
+                    f"{_fmt_seconds(agg.get('max_s')):>10s}"
+                )
+        counters = rollup.get("counters") or {}
+        if counters:
+            print("\ncounters (summed over ranks):")
+            for name, v in sorted(counters.items()):
+                print(f"  {name} = {v}")
+        bw = rollup.get("bytes_written")
+        if bw:
+            print(f"\nbytes written:     {_fmt_bytes(bw)}")
+        hw = rollup.get("budget_high_water_bytes")
+        if hw:
+            print(f"budget high-water: {_fmt_bytes(int(hw))}")
+        rss = rollup.get("peak_rss_delta_bytes")
+        if rss:
+            print(f"peak RSS delta:    {_fmt_bytes(int(rss))}")
+    if args.rank is not None:
+        s = summaries.get(args.rank)
+        if s is None:
+            print(f"error: no trace for rank {args.rank}", file=sys.stderr)
+            return 1
+        print(
+            f"\nrank {args.rank} stages "
+            f"(wall {_fmt_seconds(s.get('take_wall_s'))}, "
+            f"coverage {s.get('phase_coverage', 0) * 100:.1f}%):"
+        )
+        print(f"{'stage':<24s} {'count':>6s} {'total':>10s} {'p50':>10s} {'max':>10s}")
+        for name, agg in (s.get("stages") or {}).items():
+            print(
+                f"{name:<24s} {agg.get('count', 0):>6d} "
+                f"{_fmt_seconds(agg.get('total_s')):>10s} "
+                f"{_fmt_seconds(agg.get('p50_s')):>10s} "
+                f"{_fmt_seconds(agg.get('max_s')):>10s}"
+            )
+    return 0
+
+
 def cmd_cat(args) -> int:
     out = Snapshot(args.path).read_object(args.manifest_path)
     if isinstance(out, np.ndarray):
@@ -266,6 +376,20 @@ def main(argv=None) -> int:
         "-q", "--quiet", action="store_true", help="summary line only"
     )
     p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser(
+        "trace",
+        help="render per-take telemetry (stage timings, counters, rollup)",
+    )
+    p.add_argument("path")
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable summaries"
+    )
+    p.add_argument(
+        "--rank", type=int, default=None, metavar="K",
+        help="also print rank K's per-stage detail",
+    )
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser(
         "retain",

@@ -27,6 +27,8 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from . import telemetry
+
 _lock = threading.Lock()
 _free: List[Tuple[int, np.ndarray]] = []  # [(nbytes, buffer)]
 _free_bytes = 0
@@ -63,7 +65,9 @@ def acquire(nbytes: int) -> np.ndarray:
                 _free.pop(i)
                 _free_bytes -= n
                 _outstanding[id(buf)] = weakref.ref(buf)
+                telemetry.incr("staging_pool.hits")
                 return buf
+    telemetry.incr("staging_pool.misses")
     buf = _native.aligned_empty(nbytes)
     with _lock:
         _outstanding[id(buf)] = weakref.ref(buf)

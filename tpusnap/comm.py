@@ -203,6 +203,12 @@ class JaxCoordinationComm(Communicator):
         self._wait_watcher = None
 
     def barrier(self) -> None:
+        from . import telemetry
+
+        with telemetry.span("comm.barrier"):
+            self._barrier_impl()
+
+    def _barrier_impl(self) -> None:
         seq = self._next_seq()
         if self._wait_watcher is not None:
             # Abort-aware mode: the native wait_at_barrier blocks inside
@@ -293,6 +299,12 @@ class JaxCoordinationComm(Communicator):
         """One KV set + one barrier + ONE dir-get — O(1) RPCs per rank
         regardless of world size (the per-rank serial gets of the naive
         port serialized take/restore at scale)."""
+        from . import telemetry
+
+        with telemetry.span("comm.all_gather"):
+            return self._all_gather_object_impl(obj)
+
+    def _all_gather_object_impl(self, obj: Any) -> List[Any]:
         seq = self._next_seq()
         prefix = f"{self._namespace()}/ag{seq}"
         self._client.key_value_set(f"{prefix}/{self._rank}", _encode(obj))
@@ -315,6 +327,12 @@ class JaxCoordinationComm(Communicator):
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:
         """One set (src) / one blocking get (others); no barrier. The key
         is GC'd after a later barrier proves global consumption."""
+        from . import telemetry
+
+        with telemetry.span("comm.broadcast"):
+            return self._broadcast_object_impl(obj, src)
+
+    def _broadcast_object_impl(self, obj: Any, src: int = 0) -> Any:
         seq = self._next_seq()
         key = f"{self._namespace()}/bc{seq}"
         if self._rank == src:

@@ -403,16 +403,22 @@ class LinearBarrier:
             time.sleep(_POLL_INTERVAL_SEC)
 
     def arrive(self) -> None:
-        self.store.set(self._key("arrive", str(self.rank)), b"1")
-        if self.rank == self.leader_rank:
-            for r in range(self.world_size):
-                self._checked_get(self._key("arrive", str(r)))
+        from . import telemetry
+
+        with telemetry.span("kv.barrier_arrive"):
+            self.store.set(self._key("arrive", str(self.rank)), b"1")
+            if self.rank == self.leader_rank:
+                for r in range(self.world_size):
+                    self._checked_get(self._key("arrive", str(r)))
 
     def depart(self) -> None:
-        if self.rank == self.leader_rank:
-            self.store.set(self._key("depart"), b"1")
-        else:
-            self._checked_get(self._key("depart"))
+        from . import telemetry
+
+        with telemetry.span("kv.barrier_depart"):
+            if self.rank == self.leader_rank:
+                self.store.set(self._key("depart"), b"1")
+            else:
+                self._checked_get(self._key("depart"))
 
     def report_error(self, exc: BaseException) -> None:
         try:

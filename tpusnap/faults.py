@@ -42,6 +42,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
+from . import telemetry
 from .io_types import ReadIO, StoragePlugin, WriteIO
 
 logger = logging.getLogger(__name__)
@@ -231,7 +232,13 @@ class FaultInjectionStoragePlugin(StoragePlugin):
         """Apply latency; return whether this attempt must fail."""
         inject, latency = self._decide(kind, path)
         if latency:
+            telemetry.incr("faults.latency_injections")
             await asyncio.sleep(latency)
+        if inject:
+            # Always-on counter + instant trace event: a chaos take's
+            # persisted trace shows exactly which ops drew faults.
+            telemetry.incr(f"faults.injected.{kind}")
+            telemetry.event("fault_injected", kind=kind, path=path)
         return inject
 
     # --- plugin interface -------------------------------------------------

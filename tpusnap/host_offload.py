@@ -88,7 +88,14 @@ def to_host_offload(arr: jax.Array, memory_kind: str = "pinned_host") -> jax.Arr
         )
         memory_kind = fallback
     sharding = arr.sharding.with_memory_kind(memory_kind)
-    return jax.device_put(arr, sharding)
+    from . import telemetry
+
+    # Span covers the dispatch only (device_put is async); the staging
+    # path's np.asarray span is where completed-DMA time shows up.
+    with telemetry.span(
+        "host_offload.dtoh", bytes=arr.nbytes, memory_kind=memory_kind
+    ):
+        return jax.device_put(arr, sharding)
 
 
 def to_device(arr: jax.Array) -> jax.Array:

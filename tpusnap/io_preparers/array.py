@@ -144,7 +144,17 @@ class ArrayBufferStager(BufferStager):
                     "recorded at prepare time — the transform must be "
                     "deterministic"
                 )
+        from .. import telemetry
+
+        rec = telemetry.current()
+        dtoh_t0 = (
+            rec.now()
+            if rec is not None and rec.enabled and not isinstance(arr, np.ndarray)
+            else None
+        )
         host = np.asarray(arr)  # DtoH (no-op if DMA already done)
+        if dtoh_t0 is not None:
+            rec.record_span("dtoh", dtoh_t0, rec.now() - dtoh_t0, bytes=host.nbytes)
         mv = array_as_memoryview(host)
         want_crc = self.entry is not None and not is_checksum_disabled()
         if want_crc and self.dedup_entry is not None:
@@ -533,6 +543,15 @@ def _record_checksums(
     pass — so the next increment's dedup decisions carry more than 32
     bits of evidence per skipped unit. Small tile-less blobs record
     theirs on every take (see _DEDUP_HASH_EAGER_MAX)."""
+    from .. import telemetry
+
+    with telemetry.span("checksum", bytes=mv.nbytes):
+        _record_checksums_impl(entry, mv, record_dedup_hashes)
+
+
+def _record_checksums_impl(
+    entry: TensorEntry, mv: memoryview, record_dedup_hashes: bool
+) -> None:
     from .. import _native
 
     tile_rows, row_nbytes = _tile_geometry(entry, mv.nbytes)

@@ -29,6 +29,7 @@ _TILE_CHECKSUM_ENV_VAR = "TPUSNAP_TILE_CHECKSUM_BYTES"
 _SCRUB_CONCURRENCY_ENV_VAR = "TPUSNAP_SCRUB_CONCURRENCY"
 _RECORD_DEDUP_HASHES_ENV_VAR = "TPUSNAP_RECORD_DEDUP_HASHES"
 _DURABLE_COMMIT_ENV_VAR = "TPUSNAP_DURABLE_COMMIT"
+_TELEMETRY_ENV_VAR = "TPUSNAP_TELEMETRY"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -153,6 +154,16 @@ def is_dedup_hash_recording_forced() -> bool:
     return os.environ.get(_RECORD_DEDUP_HASHES_ENV_VAR, "0") == "1"
 
 
+def is_telemetry_enabled() -> bool:
+    """Per-take SPAN capture + persisted Chrome traces
+    (:mod:`tpusnap.telemetry`): on by default — the disabled path of a
+    span is a dict lookup, and the tier-1 overhead guard bounds the
+    enabled cost at <10% on a small take. ``TPUSNAP_TELEMETRY=0``
+    disables span capture and trace persistence; COUNTERS (retries,
+    faults, pool hits, bytes written) stay on either way."""
+    return os.environ.get(_TELEMETRY_ENV_VAR, "1") != "0"
+
+
 def get_memory_budget_override_bytes() -> Optional[int]:
     if _MEMORY_BUDGET_ENV_VAR not in os.environ:
         return None
@@ -247,4 +258,10 @@ def override_tile_checksum_bytes(nbytes: int) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_record_dedup_hashes(enabled: bool) -> Generator[None, None, None]:
     with _override_env(_RECORD_DEDUP_HASHES_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_telemetry_enabled(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(_TELEMETRY_ENV_VAR, "1" if enabled else "0"):
         yield

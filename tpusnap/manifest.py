@@ -455,6 +455,11 @@ class SnapshotMetadata:
     # self-contained snapshots and pre-field increments (readers fall
     # back to parsing).
     base_roots: Optional[List[str]] = None
+    # Free-form, JSON-serializable sidecar data riding the committed
+    # metadata (e.g. the cross-rank telemetry rollup rank 0 folds in
+    # before the commit). Readers must tolerate absence and unknown
+    # keys; nothing restore-critical may live here.
+    extras: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -465,6 +470,8 @@ class SnapshotMetadata:
             d["created_at"] = self.created_at
         if self.base_roots:
             d["base_roots"] = list(self.base_roots)
+        if self.extras:
+            d["extras"] = self.extras
         d["manifest"] = {
             k: _entry_to_dict(v) for k, v in self.manifest.items()
         }
@@ -484,6 +491,7 @@ class SnapshotMetadata:
             manifest=manifest,
             created_at=d.get("created_at"),
             base_roots=d.get("base_roots"),
+            extras=d.get("extras"),
         )
 
     @classmethod

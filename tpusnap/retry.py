@@ -38,6 +38,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
+from . import telemetry
 from .io_types import ReadIO, StoragePlugin, WriteIO
 
 logger = logging.getLogger(__name__)
@@ -205,9 +206,19 @@ class RetryingStoragePlugin(StoragePlugin):
     # --- retry core -------------------------------------------------------
 
     async def _gate(self, exc: Exception, attempt: int, op: str, path: str) -> None:
-        """Re-raise fatal/expired failures; otherwise back off."""
+        """Re-raise fatal/expired failures; otherwise back off.
+        Per-classification counters (op kind x exception type) record
+        every retried failure whether or not the op eventually
+        succeeds — the telemetry trace is how a chaos run proves its
+        injected faults actually exercised this path."""
         if not self._classify(exc) or self._deadline.expired():
+            telemetry.incr(f"retry.fatal.{op}")
             raise exc
+        telemetry.incr("retry.attempts")
+        telemetry.incr(f"retry.transient.{op}.{type(exc).__name__}")
+        telemetry.event(
+            "retry", op=op, path=path, attempt=attempt, error=type(exc).__name__
+        )
         logger.warning(
             "Transient storage error in %s(%r) (attempt %d): %s; retrying",
             op,
@@ -227,6 +238,19 @@ class RetryingStoragePlugin(StoragePlugin):
                 await self._gate(e, attempt, op, path)
                 continue
             self._deadline.report_progress()
+            if attempt > 0:
+                # Success-after-retry was previously invisible (only
+                # terminal failures logged); the INFO line + counter
+                # make transient-burst recovery auditable.
+                telemetry.incr("retry.recovered")
+                logger.info(
+                    "%s(%r) succeeded after %d retr%s (%d attempts total)",
+                    op,
+                    path,
+                    attempt,
+                    "y" if attempt == 1 else "ies",
+                    attempt + 1,
+                )
             return result
 
     # --- plugin interface -------------------------------------------------

@@ -3,7 +3,9 @@
 Counterpart of /root/reference/torchsnapshot/rss_profiler.py:32-56: a
 background thread samples the process RSS delta on an interval inside a
 context manager; benchmarks assert the peak delta stays within the
-configured memory budget.
+configured memory budget. :class:`RSSSampler` is the start/stop form
+the telemetry subsystem embeds so every take's summary carries its
+peak-RSS figure.
 """
 
 from __future__ import annotations
@@ -11,11 +13,64 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
-from typing import Generator, List
+from typing import Generator, List, Optional
 
 import psutil
 
 _DEFAULT_INTERVAL_SEC = 0.1
+
+
+class RSSSampler:
+    """Background-thread RSS-delta sampler with explicit start/stop.
+
+    Samples ``process RSS - baseline`` into ``deltas`` every
+    ``interval_sec`` between :meth:`start` and :meth:`stop`; ``stop``
+    always appends one final sample, so even a context shorter than the
+    interval records a delta. ``stop`` is idempotent and joins the
+    thread (no samples land after it returns)."""
+
+    def __init__(
+        self,
+        deltas: Optional[List[int]] = None,
+        interval_sec: float = _DEFAULT_INTERVAL_SEC,
+    ) -> None:
+        self.deltas: List[int] = deltas if deltas is not None else []
+        self.interval_sec = interval_sec
+        self._process = psutil.Process()
+        self._baseline = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RSSSampler":
+        if self._thread is not None:
+            raise RuntimeError("RSSSampler already started")
+        self._baseline = self._process.memory_info().rss
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._sample_loop, name="tpusnap-rss", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _sample_loop(self) -> None:
+        # Event.wait doubles as the interval sleep AND the prompt-stop
+        # signal: a stop() mid-interval returns immediately instead of
+        # holding the caller for a full sleep.
+        while not self._stop.wait(self.interval_sec):
+            self.deltas.append(self._process.memory_info().rss - self._baseline)
+
+    def stop(self) -> List[int]:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join()
+            self._thread = None
+            # Final delta: a sub-interval context still records one.
+            self.deltas.append(self._process.memory_info().rss - self._baseline)
+        return self.deltas
+
+    @property
+    def peak_delta(self) -> int:
+        return max(self.deltas, default=0)
 
 
 @contextmanager
@@ -25,20 +80,9 @@ def measure_rss_deltas(
     """Append RSS deltas (bytes, relative to entry) to ``rss_deltas`` every
     ``interval_sec`` until the context exits (reference rss_profiler.py:33-56).
     """
-    process = psutil.Process()
-    baseline = process.memory_info().rss
-    stop = threading.Event()
-
-    def sample() -> None:
-        while not stop.is_set():
-            rss_deltas.append(process.memory_info().rss - baseline)
-            time.sleep(interval_sec)
-
-    thread = threading.Thread(target=sample, name="tpusnap-rss", daemon=True)
-    thread.start()
+    sampler = RSSSampler(deltas=rss_deltas, interval_sec=interval_sec)
+    sampler.start()
     try:
         yield
     finally:
-        stop.set()
-        thread.join()
-        rss_deltas.append(process.memory_info().rss - baseline)
+        sampler.stop()

@@ -36,6 +36,7 @@ from typing import List, Optional, Set
 
 import psutil
 
+from . import telemetry
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq, run_on_loop
 from .knobs import get_memory_budget_override_bytes
 
@@ -257,10 +258,12 @@ class _WritePipeline:
         storage: StoragePlugin,
         executor: Optional[ThreadPoolExecutor] = None,
         hash_executor: Optional[ThreadPoolExecutor] = None,
+        tele: Optional[telemetry.TakeTelemetry] = None,
     ) -> None:
         self.write_req = write_req
         self.storage = storage
         self.executor = executor
+        self.tele = tele
         # Deferred checksums run here, NEVER on the staging executor:
         # queued hash jobs behind staging tasks would stall staging
         # completion — the async blocked window — behind work that was
@@ -277,9 +280,19 @@ class _WritePipeline:
     async def stage(self, executor: ThreadPoolExecutor) -> "_WritePipeline":
         from .io_types import SKIP_WRITE
 
+        start = self.tele.now() if self.tele is not None else 0.0
         buf = await self.write_req.buffer_stager.stage_buffer(executor)
+        if self.tele is not None:
+            self.tele.record_span(
+                "stage_buffer",
+                start,
+                self.tele.now() - start,
+                path=self.write_req.path,
+                bytes=self.staging_cost,
+            )
         if buf is SKIP_WRITE:
             self.skipped = True
+            telemetry.incr("scheduler.dedup_skipped", rec=self.tele)
             return self
         self.buf = buf
         self.buf_size = (
@@ -298,6 +311,7 @@ class _WritePipeline:
             # post-drain metadata commit.
             late = getattr(stager, "late_checksum", None)
             if late is not None:
+                hash_start = self.tele.now() if self.tele is not None else 0.0
                 loop = asyncio.get_running_loop()
                 if self.hash_executor is not None:
                     await loop.run_in_executor(
@@ -305,7 +319,25 @@ class _WritePipeline:
                     )
                 else:
                     late(self.buf)
+                if self.tele is not None:
+                    self.tele.record_span(
+                        "checksum_late",
+                        hash_start,
+                        self.tele.now() - hash_start,
+                        bytes=self.buf_size,
+                    )
+        write_start = self.tele.now() if self.tele is not None else 0.0
         await self.storage.write(WriteIO(path=self.write_req.path, buf=self.buf))
+        if self.tele is not None:
+            self.tele.record_span(
+                "storage_write",
+                write_start,
+                self.tele.now() - write_start,
+                path=self.write_req.path,
+                bytes=self.buf_size,
+            )
+        telemetry.incr("storage.bytes_written", self.buf_size, rec=self.tele)
+        telemetry.incr("storage.writes", rec=self.tele)
         # Async-clone buffers go back to the staging pool (warm pages
         # for the next clone of this size); other buffers are ignored by
         # release(). The pool is bounded by TPUSNAP_STAGING_POOL_BYTES,
@@ -346,12 +378,17 @@ async def execute_write_reqs(
         max_workers=_MAX_CPU_CONCURRENCY, thread_name_prefix="tpusnap-hash"
     )
     reporter = _Reporter(rank=rank, verb="write", total_reqs=len(write_reqs))
+    # Captured once: the drain (PendingIOWork) and late hashing may run
+    # on a background thread after a newer take replaced the ambient
+    # recorder.
+    tele = telemetry.current()
+    stage_phase_start = tele.now() if tele is not None else 0.0
     # Stage large requests first: they occupy budget longest and their I/O
     # overlaps with the staging of everything behind them.
     pipelines = deque(
         sorted(
             (
-                _WritePipeline(wr, storage, executor, hash_executor)
+                _WritePipeline(wr, storage, executor, hash_executor, tele)
                 for wr in write_reqs
             ),
             key=lambda p: p.staging_cost,
@@ -391,7 +428,20 @@ async def execute_write_reqs(
                 break  # wait for memory to free up
             pipelines.popleft()
             budget -= head.staging_cost
+            if tele is not None:
+                # High-water mark of budget in use (can exceed the
+                # budget via the ≥1 over-budget admission).
+                tele.gauge_max(
+                    "scheduler.budget_used_bytes", memory_budget_bytes - budget
+                )
             staging_tasks.add(asyncio.ensure_future(head.stage(executor)))
+
+    def staging_budget_starved() -> bool:
+        return (
+            bool(pipelines)
+            and len(staging_tasks) < _MAX_CPU_CONCURRENCY
+            and pipelines[0].staging_cost > budget
+        )
 
     def io_gate_open() -> bool:
         if not prioritize_staging:
@@ -420,9 +470,24 @@ async def execute_write_reqs(
         }
         reporter.budget_remaining = budget
 
+    stall_start: Optional[float] = None
     try:
         dispatch_staging()
         while staging_tasks or pipelines:
+            # Budget-stall EPISODES, not wait iterations: one span +
+            # counter per contiguous window in which the head request
+            # cannot be admitted, however many task completions the
+            # window spans.
+            if staging_budget_starved():
+                if stall_start is None:
+                    stall_start = tele.now() if tele is not None else 0.0
+                    telemetry.incr("scheduler.budget_waits", rec=tele)
+            elif stall_start is not None:
+                if tele is not None:
+                    tele.record_span(
+                        "budget_wait", stall_start, tele.now() - stall_start
+                    )
+                stall_start = None
             done, _ = await asyncio.wait(
                 staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
             )
@@ -455,6 +520,15 @@ async def execute_write_reqs(
         hash_executor.shutdown(wait=True)
         raise
     reporter.mark_staging_complete()
+    if tele is not None:
+        # Interior measurement of the staging window (the "stage" PHASE
+        # is recorded by the take around the whole sync_execute call).
+        tele.record_span(
+            "stage_window",
+            stage_phase_start,
+            tele.now() - stage_phase_start,
+            reqs=len(write_reqs),
+        )
 
     # Staging complete: snapshot content is now frozen. Remaining I/O is
     # handed back so the caller decides whether to drain it in the

@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional, Set
 
 import jax
 
+from . import telemetry
 from .comm import Communicator, get_communicator
 from .dist_store import (
     CoordinationKVStore,
@@ -224,8 +225,16 @@ class Snapshot:
         event_loop = asyncio.new_event_loop()
         abort_ctx = _TakeAbortContext(comm)
         abort_ctx.event_loop = event_loop
+        tele = telemetry.begin_take(comm.rank)
         try:
-            pending_io_work, metadata, path, storage, late_checksums = _take_impl(
+            (
+                pending_io_work,
+                metadata,
+                path,
+                storage,
+                late_checksums,
+                tele_commit,
+            ) = _take_impl(
                 path=path,
                 app_state=app_state,
                 storage_options=storage_options,
@@ -238,7 +247,14 @@ class Snapshot:
                 incremental_from=incremental_from,
                 abort_ctx=abort_ctx,
             )
+            drain_start = tele.now()
             pending_io_work.sync_complete(event_loop)
+            # The residual-I/O window: storage writes draining after
+            # staging completed.
+            tele.record_span(
+                "io_drain", drain_start, tele.now() - drain_start, phase=True
+            )
+            prep_start = tele.now()
             from .knobs import is_durable_commit_enabled
 
             if is_durable_commit_enabled():
@@ -251,6 +267,10 @@ class Snapshot:
                 # final — publish before the barrier; rank 0 applies
                 # after it (every rank arrived ⟹ every rank published).
                 late_checksums.publish()
+            # Writes drained: freeze + persist this rank's trace inside
+            # the snapshot and publish its summary — BEFORE the commit
+            # barrier, preserving metadata-written-last.
+            tele_commit.persist(storage, event_loop, abort_ctx, prep_start)
             # With the abort watcher armed (multi-process), both commit
             # barriers poll for peer abort records and raise
             # TakeAbortedError within seconds instead of burning the
@@ -259,6 +279,9 @@ class Snapshot:
             if comm.rank == 0:
                 if late_checksums is not None:
                     late_checksums.apply(metadata.manifest)
+                # Barrier passed ⟹ every rank published its telemetry
+                # summary: fold the cross-rank rollup into the extras.
+                tele_commit.apply(metadata)
                 abort_ctx.mark_commit_started()
                 _write_metadata(storage, metadata, event_loop)
             comm.barrier()
@@ -269,14 +292,16 @@ class Snapshot:
             abort_ctx.on_failure(e)
             raise
         finally:
+            telemetry.end_take(tele)
             abort_ctx.disarm()
             event_loop.close()
         snapshot = cls(path, storage_options, comm)
-        if comm.rank == 0 or late_checksums is None:
+        if comm.rank == 0:
             snapshot._metadata = metadata
-        # else: the in-memory copy is missing other ranks' late
-        # checksums — the first metadata access reads the committed
-        # file, which rank 0 wrote fully patched.
+        # else: the non-leader's in-memory copy is missing rank 0's
+        # leader-only mutations (late checksums, the telemetry rollup
+        # extras) — the first metadata access reads the committed file,
+        # which rank 0 wrote fully patched.
         return snapshot
 
     @classmethod
@@ -295,8 +320,16 @@ class Snapshot:
         event_loop = asyncio.new_event_loop()
         abort_ctx = _TakeAbortContext(comm)
         abort_ctx.event_loop = event_loop
+        tele = telemetry.begin_take(comm.rank)
         try:
-            pending_io_work, metadata, path, storage, late_checksums = _take_impl(
+            (
+                pending_io_work,
+                metadata,
+                path,
+                storage,
+                late_checksums,
+                tele_commit,
+            ) = _take_impl(
                 path=path,
                 app_state=app_state,
                 storage_options=storage_options,
@@ -321,8 +354,10 @@ class Snapshot:
                 storage_options=storage_options,
                 late_checksums=late_checksums,
                 abort_ctx=abort_ctx,
+                tele_commit=tele_commit,
             )
         except BaseException as e:
+            telemetry.end_take(tele)
             abort_ctx.on_failure(e)
             abort_ctx.disarm()
             event_loop.close()
@@ -564,6 +599,7 @@ class _TakeAbortContext:
         self.event_loop: Optional[asyncio.AbstractEventLoop] = None
         self.write_paths: List[str] = []
         self.late_checksums: Optional["_LateChecksums"] = None
+        self.tele_commit: Optional["_TelemetryCommit"] = None
         self.commit_started = False
 
     def arm(self, monitor: TakeAbortMonitor) -> None:
@@ -599,6 +635,11 @@ class _TakeAbortContext:
         if self.late_checksums is not None:
             try:
                 self.late_checksums.discard()
+            except Exception:
+                pass
+        if self.tele_commit is not None:
+            try:
+                self.tele_commit.discard()
             except Exception:
                 pass
         if self.storage is not None and self.event_loop is not None:
@@ -660,6 +701,11 @@ def _take_impl(
     _validate_app_state(app_state)
     rank = comm.rank
     multi = comm.world_size > 1
+    # Contiguous phase spans (state_dict → plan → prepare → stage →
+    # manifest_gather → metadata) tiling the take's timeline from t0;
+    # the trace CLI's coverage figure is their sum over the take
+    # wall-clock.
+    mark = telemetry.phase_marker(from_start=True)
 
     # Capture RNG state on entry; other statefuls' state_dict() calls may
     # consume RNG, and take() must be invariant (reference :332-374).
@@ -690,6 +736,7 @@ def _take_impl(
     # Undo any RNG perturbation caused by gathering state dicts.
     for key, captured in rng_captured.items():
         app_state[key].load_state_dict(captured)
+    mark("state_dict", keys=len(keys))
 
     # Local replicated candidates: glob-matched host-side values. A
     # fully-replicated multi-process jax.Array needs no glob — it routes
@@ -773,8 +820,13 @@ def _take_impl(
                 TakeAbortMonitor(_get_kv_store(comm), take_id, rank)
             )
     else:
+        take_id = None
         replicated_paths = matched
         traced_geometry = {}
+    # The G1 gather + write-load partition plan (single-process: just
+    # the glob intersection — cheap, but keeping the phases contiguous
+    # is what makes coverage meaningful).
+    mark("plan")
 
     storage = url_to_storage_plugin_in_event_loop(
         path, event_loop, storage_options
@@ -887,6 +939,7 @@ def _take_impl(
     memory_budget = get_process_memory_budget_bytes(
         comm, local_world_size=local_world_size
     )
+    mark("prepare", write_reqs=len(write_reqs))
     pending_io_work = sync_execute_write_reqs(
         write_reqs,
         storage,
@@ -905,7 +958,12 @@ def _take_impl(
     # and those must land in the committed metadata. The reference
     # gathers before scheduling (snapshot.py:842-853) only because its
     # entries are final at prepare time.
+    # The staging window (the phase async_take blocks training on),
+    # including the scheduler's dispatch/wind-down; the scheduler's own
+    # "stage_window" op span is the interior measurement.
+    mark("stage", write_reqs=len(write_reqs))
     global_manifest = _gather_manifest(entries, comm)
+    mark("manifest_gather")
     import time
 
     metadata = SnapshotMetadata(
@@ -923,7 +981,11 @@ def _take_impl(
         )
         or None,
     )
-    return pending_io_work, metadata, path, storage, late_checksums
+    mark("metadata")
+    tele_commit = _TelemetryCommit(mark.rec, comm, take_id)
+    if abort_ctx is not None:
+        abort_ctx.tele_commit = tele_commit
+    return pending_io_work, metadata, path, storage, late_checksums, tele_commit
 
 
 def _referenced_base_roots(
@@ -1240,6 +1302,138 @@ class _LateChecksums:
 _NO_LATE_CHECKSUMS = None  # single-process takes thread None through
 
 
+class _TelemetryCommit:
+    """Transport for per-take telemetry (:mod:`tpusnap.telemetry`),
+    riding the commit protocol exactly like :class:`_LateChecksums`:
+
+    - ``persist`` (every rank, writes drained, BEFORE the commit
+      barrier): freeze the recorder, write this rank's Chrome trace to
+      ``.tpusnap/telemetry/rank_<k>.json`` through the take's own
+      storage plugin, and publish the compact summary under a
+      take-scoped KV key. Persisting before the barrier preserves the
+      metadata-written-last invariant: an abort can orphan a trace
+      file (registered for the abort path's blob cleanup), but a
+      committed snapshot never references state that predates its
+      traces.
+    - ``apply`` (rank 0, after the barrier's arrive ⟹ every rank
+      published): ONE ``try_get_dir`` collects the summaries, the
+      cross-rank rollup lands in ``metadata.extras["telemetry"]``, and
+      the KV prefix is deleted.
+
+    Everything is best-effort: telemetry failures log and never fail a
+    take."""
+
+    def __init__(
+        self,
+        tele: Optional[telemetry.TakeTelemetry],
+        comm: Communicator,
+        take_id: Optional[str],
+    ) -> None:
+        self.tele = tele
+        self.comm = comm
+        self.take_id = take_id
+        self._summary: Optional[Dict[str, Any]] = None
+
+    def _prefix(self) -> str:
+        return f"tpusnap_tele/{self.take_id}/"
+
+    def _key(self, rank: int) -> str:
+        return f"{self._prefix()}{rank}"
+
+    def persist(
+        self,
+        storage: StoragePlugin,
+        event_loop: asyncio.AbstractEventLoop,
+        abort_ctx: Optional["_TakeAbortContext"] = None,
+        prep_start: Optional[float] = None,
+    ) -> None:
+        if self.tele is None:
+            return
+        try:
+            self.tele.finalize()
+            if prep_start is not None:
+                # Tail phase (durable dirent flush + late-checksum
+                # publish, between the I/O drain and this freeze) so the
+                # phases tile the whole persisted wall-clock.
+                self.tele.record_span(
+                    "commit_prep",
+                    prep_start,
+                    max(self.tele.take_wall_s - prep_start, 0.0),
+                    phase=True,
+                )
+            self._summary = self.tele.summary()
+        except Exception:
+            logger.warning("Telemetry summary failed (non-fatal)", exc_info=True)
+            return
+        if self.tele.enabled:
+            from .telemetry import telemetry_rank_path
+
+            trace_path = telemetry_rank_path(self.tele.rank)
+            if abort_ctx is not None:
+                # An aborting take deletes its staged blobs so the path
+                # stays reusable; the trace file is cleaned up with them.
+                abort_ctx.write_paths.append(trace_path)
+            try:
+                storage.sync_write(
+                    WriteIO(path=trace_path, buf=self.tele.to_json().encode("utf-8")),
+                    event_loop,
+                )
+            except Exception:
+                logger.warning(
+                    "Failed to persist telemetry trace %r (non-fatal)",
+                    trace_path,
+                    exc_info=True,
+                )
+        if self.comm.world_size > 1 and self.take_id is not None:
+            import pickle
+
+            try:
+                _get_kv_store(self.comm).set(
+                    self._key(self.comm.rank), pickle.dumps(self._summary)
+                )
+            except Exception:
+                logger.warning(
+                    "Failed to publish telemetry summary (non-fatal)",
+                    exc_info=True,
+                )
+
+    def apply(self, metadata: SnapshotMetadata) -> None:
+        """Leader-only, after the commit barrier's arrive phase."""
+        summaries = []
+        if self.comm.world_size > 1 and self.take_id is not None:
+            import pickle
+
+            try:
+                store = _get_kv_store(self.comm)
+                blobs = store.try_get_dir(self._prefix())
+                for raw in (blobs or {}).values():
+                    try:
+                        summaries.append(pickle.loads(raw))
+                    except Exception:
+                        pass
+                store.delete_prefix(self._prefix())
+            except Exception:
+                summaries = []
+        if not summaries and self._summary is not None:
+            summaries = [self._summary]
+        try:
+            rollup = telemetry.rollup_summaries(summaries)
+        except Exception:
+            logger.warning("Telemetry rollup failed (non-fatal)", exc_info=True)
+            return
+        if rollup:
+            metadata.extras = dict(metadata.extras or {})
+            metadata.extras["telemetry"] = rollup
+
+    def discard(self) -> None:
+        """Abort path: drop this rank's published summary blob."""
+        if self.comm.world_size > 1 and self.take_id is not None:
+            try:
+                _get_kv_store(self.comm).delete_prefix(self._key(self.comm.rank))
+            except Exception:
+                pass
+
+
 def _write_metadata(
     storage: StoragePlugin,
     metadata: SnapshotMetadata,
@@ -1454,6 +1648,7 @@ class PendingSnapshot(_BackgroundWork):
         storage_options: Optional[Dict[str, Any]] = None,
         late_checksums: Optional["_LateChecksums"] = None,
         abort_ctx: Optional["_TakeAbortContext"] = None,
+        tele_commit: Optional["_TelemetryCommit"] = None,
     ) -> None:
         self.path = path
         self._pending_io_work = pending_io_work
@@ -1464,6 +1659,7 @@ class PendingSnapshot(_BackgroundWork):
         self._storage_options = storage_options
         self._late_checksums = late_checksums
         self._abort_ctx = abort_ctx
+        self._tele_commit = tele_commit
         self._snapshot: Optional[Snapshot] = None
 
         # Barrier identity must be agreed on the MAIN thread (this may
@@ -1491,10 +1687,28 @@ class PendingSnapshot(_BackgroundWork):
         # watcher above.
         if abort_ctx is not None:
             abort_ctx.disarm()
+        # Control is about to return to training: release the recorder's
+        # process-global slot (a newer take may install its own); the
+        # background drain records through captured references + the
+        # thread-local overlay in _body.
+        if tele_commit is not None and tele_commit.tele is not None:
+            telemetry.release_global(tele_commit.tele)
         self._start()
 
     def _body(self) -> None:
+        tele = self._tele_commit.tele if self._tele_commit is not None else None
+        with telemetry.use(tele):
+            self._body_impl()
+
+    def _body_impl(self) -> None:
+        tele = self._tele_commit.tele if self._tele_commit is not None else None
+        drain_start = tele.now() if tele is not None else 0.0
         self._pending_io_work.sync_complete(self._event_loop)
+        if tele is not None:
+            tele.record_span(
+                "io_drain", drain_start, tele.now() - drain_start, phase=True
+            )
+        prep_start = tele.now() if tele is not None else None
         from .knobs import is_durable_commit_enabled
 
         if is_durable_commit_enabled():
@@ -1506,6 +1720,12 @@ class PendingSnapshot(_BackgroundWork):
             # (pure KV traffic — legal off the main thread, like the
             # barrier itself).
             self._late_checksums.publish()
+        if self._tele_commit is not None:
+            # Writes drained: persist this rank's trace + publish its
+            # summary before the commit barrier (metadata still last).
+            self._tele_commit.persist(
+                self._storage, self._event_loop, self._abort_ctx, prep_start
+            )
         self._barrier.arrive()
         if self._comm.rank == 0:
             # arrive() returned ⟹ every rank arrived ⟹ every rank
@@ -1513,6 +1733,8 @@ class PendingSnapshot(_BackgroundWork):
             # delete the keys, commit.
             if self._late_checksums is not None:
                 self._late_checksums.apply(self._metadata.manifest)
+            if self._tele_commit is not None:
+                self._tele_commit.apply(self._metadata)
             if self._abort_ctx is not None:
                 self._abort_ctx.mark_commit_started()
             _write_metadata(self._storage, self._metadata, self._event_loop)
@@ -1536,10 +1758,11 @@ class PendingSnapshot(_BackgroundWork):
         except Exception:
             pass
         snapshot = Snapshot(self.path, self._storage_options, self._comm)
-        if self._comm.rank == 0 or self._late_checksums is None:
+        if self._comm.rank == 0:
             snapshot._metadata = self._metadata
-        # else: stale (missing other ranks' late checksums) — lazily
-        # read the committed, fully-patched file instead.
+        # else: stale (missing rank 0's late-checksum patches and
+        # telemetry rollup extras) — lazily read the committed,
+        # fully-patched file instead.
         self._snapshot = snapshot
 
     def _on_error(self, exc: BaseException) -> None:
@@ -1558,6 +1781,8 @@ class PendingSnapshot(_BackgroundWork):
     def _cleanup(self) -> None:
         self._storage.sync_close(self._event_loop)
         self._event_loop.close()
+        if self._tele_commit is not None and self._tele_commit.tele is not None:
+            telemetry.end_take(self._tele_commit.tele)
 
     def wait(self) -> Snapshot:
         self._join_and_reraise()

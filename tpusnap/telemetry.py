@@ -1,0 +1,507 @@
+"""Per-take telemetry: stage spans, rank counters, persisted traces.
+
+The paper's core claims — overlapped DtoH and storage I/O, memory-budget
+driven scheduling, write load spread across ranks — are only verifiable
+if a running take can say *where* its wall-clock and budget went, per
+rank. This module is that instrument:
+
+- **Spans** — monotonic-clock intervals recorded around every pipeline
+  stage (flatten, the G1 plan gather, prepare, staging, checksum
+  passes, storage writes, budget waits, barriers/KV waits). Span
+  capture is gated by the ``TPUSNAP_TELEMETRY`` knob (on by default;
+  the disabled path is a single dict lookup + ``None`` check).
+- **Counters** — atomic, ALWAYS-ON (knob-independent): retry attempts
+  per classification, injected faults, staging-pool hits/misses, bytes
+  written, dedup skips. Cheap enough for the hot path (one lock'd
+  ``dict`` add).
+- **Gauges** — high-water marks (scheduler budget in use, peak RSS
+  delta sampled by :mod:`tpusnap.rss_profiler`).
+- **TakeTelemetry** — the per-take aggregate. One is installed
+  process-globally for the duration of a take (background drain
+  threads re-install it thread-locally via :func:`use`); module-level
+  :func:`span`/:func:`incr`/:func:`event` record into it from any
+  layer without threading a handle through every call.
+
+Persistence: each rank serializes its trace to **Chrome trace-event
+JSON** (load it in ``chrome://tracing`` / Perfetto) plus a compact
+summary, stored inside the snapshot at
+``.tpusnap/telemetry/rank_<k>.json`` — written after the rank's blob
+writes drain and BEFORE the metadata commit, so the
+metadata-written-last invariant holds (a trace file can be orphaned by
+an abort; a committed snapshot missing its trace only means telemetry
+was disabled or its best-effort write failed). Rank 0 additionally
+folds a cross-rank rollup (per-stage p50/max, bytes written, retries,
+budget high-water) into the take's metadata ``extras`` — surfaced by
+``python -m tpusnap trace <path>``.
+
+External collectors subscribe through :class:`MetricsSink`
+(``register_metrics_sink``): per-span and per-counter callbacks plus
+one take-summary callback. Sink exceptions are swallowed — telemetry
+must never fail a take.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Generator, List, Optional, Tuple
+
+from .knobs import is_telemetry_enabled
+
+TELEMETRY_DIR = ".tpusnap/telemetry"
+
+# Summary of the most recent completed take in this process (set by
+# end_take); benchmarks read this to embed the stage breakdown in their
+# JSON without re-reading the snapshot.
+LAST_TAKE_SUMMARY: Optional[Dict[str, Any]] = None
+
+
+def telemetry_rank_path(rank: int) -> str:
+    """Storage-relative path of one rank's persisted trace."""
+    return f"{TELEMETRY_DIR}/rank_{rank}.json"
+
+
+# --------------------------------------------------------------- sinks
+
+
+class MetricsSink:
+    """Subscriber interface for external collectors. Override any
+    subset; default implementations are no-ops. Callbacks run inline on
+    the recording thread and must be fast and non-raising (raises are
+    swallowed, but the time is still yours)."""
+
+    def on_span(self, name: str, duration_s: float, attrs: Dict[str, Any]) -> None:
+        pass
+
+    def on_counter(self, name: str, delta: int, value: int) -> None:
+        pass
+
+    def on_take_summary(self, summary: Dict[str, Any]) -> None:
+        pass
+
+
+_sinks: Tuple[MetricsSink, ...] = ()
+_sinks_lock = threading.Lock()
+
+
+def register_metrics_sink(sink: MetricsSink) -> None:
+    global _sinks
+    with _sinks_lock:
+        _sinks = _sinks + (sink,)
+
+
+def unregister_metrics_sink(sink: MetricsSink) -> None:
+    global _sinks
+    with _sinks_lock:
+        _sinks = tuple(s for s in _sinks if s is not sink)
+
+
+def _notify(method: str, *args) -> None:
+    for sink in _sinks:
+        try:
+            getattr(sink, method)(*args)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------- global counters
+
+# Process-lifetime counters, knob-independent: retry/fault/pool events
+# are recorded here even outside a take, so tests and sinks can observe
+# them without a snapshot in flight.
+_global_counters: Dict[str, int] = {}
+_counters_lock = threading.Lock()
+
+
+def counter_value(name: str) -> int:
+    with _counters_lock:
+        return _global_counters.get(name, 0)
+
+
+def reset_global_counters() -> None:
+    """Test aid; production code never resets."""
+    with _counters_lock:
+        _global_counters.clear()
+
+
+# ------------------------------------------------------- TakeTelemetry
+
+
+class TakeTelemetry:
+    """Thread-safe per-take aggregate of spans, counters and gauges.
+
+    ``enabled`` gates SPAN capture only (the TPUSNAP_TELEMETRY knob,
+    sampled once at construction so a take is internally consistent);
+    counters and gauges are always recorded. Timestamps are offsets
+    from the take's start on the monotonic clock."""
+
+    def __init__(self, rank: int, enabled: Optional[bool] = None) -> None:
+        self.rank = rank
+        self.enabled = is_telemetry_enabled() if enabled is None else enabled
+        self.t0 = time.monotonic()
+        self.wall0 = time.time()
+        self._lock = threading.Lock()
+        # (name, start_s, dur_s, thread_name, is_phase, attrs)
+        self._spans: List[Tuple[str, float, float, str, bool, Dict[str, Any]]] = []
+        # (name, ts_s, thread_name, attrs) — instant events (faults, retries)
+        self._events: List[Tuple[str, float, str, Dict[str, Any]]] = []
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._finalized_wall_s: Optional[float] = None
+        self._rss_sampler = None
+        if self.enabled:
+            try:
+                from .rss_profiler import RSSSampler
+
+                self._rss_sampler = RSSSampler(interval_sec=0.1)
+                self._rss_sampler.start()
+            except Exception:
+                self._rss_sampler = None
+
+    # --- recording ------------------------------------------------------
+
+    def now(self) -> float:
+        return time.monotonic() - self.t0
+
+    def record_span(
+        self,
+        name: str,
+        start_s: float,
+        dur_s: float,
+        phase: bool = False,
+        **attrs: Any,
+    ) -> None:
+        if not self.enabled:
+            return
+        thread = threading.current_thread().name
+        with self._lock:
+            self._spans.append((name, start_s, dur_s, thread, phase, attrs))
+        _notify("on_span", name, dur_s, attrs)
+
+    @contextmanager
+    def span(
+        self, name: str, phase: bool = False, **attrs: Any
+    ) -> Generator[None, None, None]:
+        if not self.enabled:
+            yield
+            return
+        start = self.now()
+        try:
+            yield
+        finally:
+            self.record_span(name, start, self.now() - start, phase=phase, **attrs)
+
+    def event(self, name: str, **attrs: Any) -> None:
+        if not self.enabled:
+            return
+        thread = threading.current_thread().name
+        with self._lock:
+            self._events.append((name, self.now(), thread, attrs))
+
+    def incr(self, name: str, n: int = 1) -> None:
+        # No sink notification here: the module-level incr() notifies
+        # with the PROCESS-GLOBAL cumulative value, so sinks see one
+        # consistent monotonic domain instead of take-local resets.
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge_max(self, name: str, value: float) -> None:
+        with self._lock:
+            if value > self._gauges.get(name, float("-inf")):
+                self._gauges[name] = value
+
+    # --- finalization ---------------------------------------------------
+
+    def finalize(self) -> None:
+        """Freeze the take wall-clock and stop the RSS sampler.
+        Idempotent; spans recorded after this still reach sinks but are
+        not part of the persisted trace's coverage window."""
+        if self._finalized_wall_s is not None:
+            return
+        self._finalized_wall_s = self.now()
+        if self._rss_sampler is not None:
+            try:
+                self._rss_sampler.stop()
+                self.gauge_max(
+                    "peak_rss_delta_bytes", float(self._rss_sampler.peak_delta)
+                )
+            except Exception:
+                pass
+            self._rss_sampler = None
+
+    @property
+    def take_wall_s(self) -> float:
+        return (
+            self._finalized_wall_s
+            if self._finalized_wall_s is not None
+            else self.now()
+        )
+
+    # --- serialization --------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact aggregate: per-span-name {count, total_s, p50_s,
+        max_s}, phase list (for wall-clock coverage), counters, gauges."""
+        with self._lock:
+            spans = list(self._spans)
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            events = list(self._events)
+        by_name: Dict[str, List[float]] = {}
+        phase_total: Dict[str, float] = {}
+        for name, _start, dur, _thread, phase, _attrs in spans:
+            by_name.setdefault(name, []).append(dur)
+            if phase:
+                phase_total[name] = phase_total.get(name, 0.0) + dur
+        stages = {}
+        for name, durs in sorted(by_name.items()):
+            durs_sorted = sorted(durs)
+            stages[name] = {
+                "count": len(durs),
+                "total_s": round(sum(durs), 6),
+                "p50_s": round(durs_sorted[len(durs_sorted) // 2], 6),
+                "max_s": round(durs_sorted[-1], 6),
+            }
+        take_wall = self.take_wall_s
+        phase_sum = sum(phase_total.values())
+        return {
+            "rank": self.rank,
+            "enabled": self.enabled,
+            "started_at": self.wall0,
+            "take_wall_s": round(take_wall, 6),
+            "phases": {k: round(v, 6) for k, v in phase_total.items()},
+            "phase_coverage": (
+                round(min(phase_sum / take_wall, 1.0), 4) if take_wall > 0 else 0.0
+            ),
+            "stages": stages,
+            "counters": counters,
+            "gauges": gauges,
+            "events": len(events),
+        }
+
+    def chrome_trace_events(self) -> List[Dict[str, Any]]:
+        """Chrome trace-event list: complete ("X") events for spans,
+        instant ("i") events for faults/retries, ts/dur in microseconds,
+        pid = rank, tid = recording thread name."""
+        with self._lock:
+            spans = list(self._spans)
+            events = list(self._events)
+        out: List[Dict[str, Any]] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self.rank,
+                "tid": 0,
+                "args": {"name": f"tpusnap rank {self.rank}"},
+            }
+        ]
+        for name, start, dur, thread, phase, attrs in spans:
+            ev: Dict[str, Any] = {
+                "name": name,
+                "ph": "X",
+                "cat": "phase" if phase else "op",
+                "ts": round(start * 1e6, 1),
+                "dur": round(dur * 1e6, 1),
+                "pid": self.rank,
+                "tid": thread,
+            }
+            if attrs:
+                ev["args"] = attrs
+            out.append(ev)
+        for name, ts, thread, attrs in events:
+            ev = {
+                "name": name,
+                "ph": "i",
+                "cat": "event",
+                "s": "p",
+                "ts": round(ts * 1e6, 1),
+                "pid": self.rank,
+                "tid": thread,
+            }
+            if attrs:
+                ev["args"] = attrs
+            out.append(ev)
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "rank": self.rank,
+                "summary": self.summary(),
+                "traceEvents": self.chrome_trace_events(),
+            },
+            sort_keys=False,
+        )
+
+
+# --------------------------------------------- ambient current recorder
+
+# The take installs its recorder process-globally; background threads
+# (async commit drain) overlay it thread-locally via use() so a NEWER
+# take's global install cannot steal their spans.
+_global_current: Optional[TakeTelemetry] = None
+_tls = threading.local()
+
+
+def current() -> Optional[TakeTelemetry]:
+    rec = getattr(_tls, "current", None)
+    return rec if rec is not None else _global_current
+
+
+def begin_take(rank: int) -> TakeTelemetry:
+    """Create a take recorder and install it as the process-global
+    current. Pipeline layers then record through the module-level
+    span()/incr()/event() without threading a handle."""
+    global _global_current
+    rec = TakeTelemetry(rank)
+    _global_current = rec
+    return rec
+
+
+def release_global(rec: TakeTelemetry) -> None:
+    """Uninstall ``rec`` as the process-global current (no-op when a
+    newer take already replaced it). async_take calls this when control
+    returns to training — the background drain keeps recording through
+    captured references and a thread-local :func:`use` overlay."""
+    global _global_current
+    if _global_current is rec:
+        _global_current = None
+
+
+def end_take(rec: TakeTelemetry) -> None:
+    """Finalize + uninstall (only if still installed) and publish the
+    summary to LAST_TAKE_SUMMARY and the sinks' on_take_summary."""
+    global LAST_TAKE_SUMMARY
+    rec.finalize()
+    release_global(rec)
+    summary = rec.summary()
+    LAST_TAKE_SUMMARY = summary
+    _notify("on_take_summary", summary)
+
+
+@contextmanager
+def use(rec: Optional[TakeTelemetry]) -> Generator[None, None, None]:
+    """Thread-local overlay: make ``rec`` the current recorder on THIS
+    thread (async commit / background restore threads)."""
+    prev = getattr(_tls, "current", None)
+    _tls.current = rec
+    try:
+        yield
+    finally:
+        _tls.current = prev
+
+
+@contextmanager
+def span(name: str, phase: bool = False, **attrs: Any) -> Generator[None, None, None]:
+    """Record a span into the ambient recorder; no-op (one lookup) when
+    no take is in flight or span capture is knob-disabled."""
+    rec = current()
+    if rec is None or not rec.enabled:
+        yield
+        return
+    with rec.span(name, phase=phase, **attrs):
+        yield
+
+
+def event(name: str, **attrs: Any) -> None:
+    rec = current()
+    if rec is not None:
+        rec.event(name, **attrs)
+
+
+def incr(name: str, n: int = 1, rec: Optional[TakeTelemetry] = None) -> None:
+    """Always-on counter: bumps the process-global counter AND the
+    in-flight take's (the ambient one, or an explicit ``rec`` captured
+    by code that outlives the take's global install). Sinks are
+    notified with the process-global cumulative value — one monotonic
+    domain regardless of take boundaries."""
+    with _counters_lock:
+        global_value = _global_counters.get(name, 0) + n
+        _global_counters[name] = global_value
+    rec = rec if rec is not None else current()
+    if rec is not None:
+        rec.incr(name, n)
+    _notify("on_counter", name, n, global_value)
+
+
+def gauge_max(name: str, value: float) -> None:
+    rec = current()
+    if rec is not None:
+        rec.gauge_max(name, value)
+
+
+class PhaseMarker:
+    """Sequential PHASE-span recorder for a linear pipeline: each call
+    records a phase span from the previous mark (or construction) to
+    now, so the recorded phases tile the timeline with no gaps — which
+    is what makes the trace CLI's wall-clock coverage meaningful."""
+
+    def __init__(
+        self, rec: Optional[TakeTelemetry] = None, from_start: bool = False
+    ) -> None:
+        self.rec = rec if rec is not None else current()
+        # from_start anchors the first phase at the recorder's t0, so
+        # recorder-construction overhead (RSS sampler thread spawn)
+        # cannot open a coverage hole before the first phase.
+        self.last = (
+            self.rec.now()
+            if self.rec is not None and self.rec.enabled and not from_start
+            else 0.0
+        )
+
+    def __call__(self, name: str, **attrs: Any) -> None:
+        if self.rec is None or not self.rec.enabled:
+            return
+        now = self.rec.now()
+        self.rec.record_span(name, self.last, now - self.last, phase=True, **attrs)
+        self.last = now
+
+def phase_marker(from_start: bool = False) -> PhaseMarker:
+    return PhaseMarker(from_start=from_start)
+
+
+# -------------------------------------------------------------- rollup
+
+
+def rollup_summaries(summaries: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Cross-rank rollup rank 0 folds into the metadata extras: per
+    stage, the p50/max over ranks of each rank's TOTAL time in that
+    stage; summed counters; max gauges; slowest-rank wall-clock."""
+    summaries = [s for s in summaries if s]
+    if not summaries:
+        return {}
+    stage_totals: Dict[str, List[float]] = {}
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
+    for s in summaries:
+        for name, agg in (s.get("stages") or {}).items():
+            stage_totals.setdefault(name, []).append(agg.get("total_s", 0.0))
+        for name, v in (s.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + v
+        for name, v in (s.get("gauges") or {}).items():
+            if v > gauges.get(name, float("-inf")):
+                gauges[name] = v
+    stages = {}
+    for name, totals in sorted(stage_totals.items()):
+        ts = sorted(totals)
+        stages[name] = {
+            "ranks": len(ts),
+            "p50_s": round(ts[len(ts) // 2], 6),
+            "max_s": round(ts[-1], 6),
+        }
+    return {
+        "ranks": len(summaries),
+        "take_wall_s": round(max(s.get("take_wall_s", 0.0) for s in summaries), 6),
+        "phase_coverage_min": round(
+            min(s.get("phase_coverage", 0.0) for s in summaries), 4
+        ),
+        "stages": stages,
+        "counters": counters,
+        "gauges": gauges,
+        "bytes_written": counters.get("storage.bytes_written", 0),
+        "retry_attempts": counters.get("retry.attempts", 0),
+        "budget_high_water_bytes": gauges.get("scheduler.budget_used_bytes"),
+        "peak_rss_delta_bytes": gauges.get("peak_rss_delta_bytes"),
+    }
