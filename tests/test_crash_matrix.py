@@ -19,6 +19,16 @@ Windows:
                      temp+rename atomicity window);
 - ``durable``      — TPUSNAP_DURABLE_COMMIT=1, inside the pre-barrier
                      durable flush of created dirents.
+- ``journal``      — inside the take-journal write, before any blob
+                     write (the lifecycle layer's own commit point).
+
+Every window additionally asserts the LIFECYCLE classification
+(``tpusnap.lifecycle.fsck_snapshot``): a committed directory fscks as
+``committed``; an uncommitted one as ``torn`` (journal present) or
+``empty`` — never misclassified as committed. Further down:
+SIGKILL-mid-GC, salvage-resume of a torn take (≥50% byte reuse asserted
+via the salvaged-bytes counter), and SIGKILL mid-materialize /
+mid-retention.
 
 Each (window, seed) run jitters the kill delay within the window, so
 kills land at varied instants — including occasionally AFTER the
@@ -117,6 +127,13 @@ elif window == "durable":
         mark_and_linger()
         return await orig_flush(self)
     fs_mod.FSStoragePlugin.flush_created_dirs = hooked_flush
+elif window == "journal":
+    import tpusnap.lifecycle as lc_mod
+    orig_journal = lc_mod.write_journal
+    def hooked_journal(storage, event_loop, journal):
+        mark_and_linger()
+        return orig_journal(storage, event_loop, journal)
+    lc_mod.write_journal = hooked_journal
 else:
     raise SystemExit(f"unknown window {window}")
 
@@ -138,11 +155,11 @@ print("DONE", flush=True)
 """
 
 
-def _run_window(tmp_path, window: str, seed: int) -> None:
+def _run_window(tmp_path, window: str, seed: int, extra_env=None) -> None:
     import select
 
     path = str(tmp_path / "snap")
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(extra_env or {}))
     proc = subprocess.Popen(
         [sys.executable, "-c", _CHILD, window, path, str(seed)],
         env=env,
@@ -191,6 +208,8 @@ def _run_window(tmp_path, window: str, seed: int) -> None:
                 pass
             proc.wait()
 
+    from tpusnap.lifecycle import fsck_snapshot
+
     meta_path = os.path.join(path, ".snapshot_metadata")
     if os.path.exists(meta_path):
         # Committed ⟹ must be a complete, bit-exact, clean snapshot.
@@ -204,14 +223,31 @@ def _run_window(tmp_path, window: str, seed: int) -> None:
         for k, v in expected.items():
             assert np.array_equal(target["app"][k], v), (window, seed, k)
         assert verify_snapshot(path).clean, (window, seed)
+        report = fsck_snapshot(path)
+        assert report.state == "committed", (window, seed, report.summary())
+        assert not report.missing_referenced, (window, seed)
     else:
         # Not committed ⟹ invisible.
         with pytest.raises(RuntimeError, match="not a snapshot"):
             Snapshot(path).metadata
+        # ... and the lifecycle layer classifies the debris: a journal
+        # marker makes it torn; pre-journal kills leave empty/foreign.
+        report = fsck_snapshot(path)
+        if os.path.exists(os.path.join(path, ".tpusnap/journal")):
+            assert report.state == "torn", (window, seed, report.summary())
+        else:
+            assert report.state in ("empty", "foreign"), (
+                window,
+                seed,
+                report.summary(),
+            )
+
+
+_WINDOWS = ["staging", "residual_io", "metadata", "durable", "journal"]
 
 
 @pytest.mark.soak
-@pytest.mark.parametrize("window", ["staging", "residual_io", "metadata", "durable"])
+@pytest.mark.parametrize("window", _WINDOWS)
 @pytest.mark.parametrize("seed", range(3))
 def test_crash_matrix(tmp_path, window, seed):
     """Fast seeds: run in tier-1 so every commit window stays covered."""
@@ -219,12 +255,393 @@ def test_crash_matrix(tmp_path, window, seed):
 
 
 @pytest.mark.soak
+@pytest.mark.parametrize("window", ["metadata", "staging"])
+def test_crash_matrix_pure_python(tmp_path, window):
+    """The pure-Python fallback path (TPUSNAP_DISABLE_NATIVE=1) must keep
+    the same crash guarantees — fallback writes have different syscall
+    patterns and checksum algorithms, and the metadata self-checksum must
+    verify under the fallback CRC too. Fast subset, runs in tier-1."""
+    _run_window(tmp_path, window, 0, extra_env={"TPUSNAP_DISABLE_NATIVE": "1"})
+
+
+@pytest.mark.soak
 @pytest.mark.slow
-@pytest.mark.parametrize("window", ["staging", "residual_io", "metadata", "durable"])
+@pytest.mark.parametrize("window", _WINDOWS)
 @pytest.mark.parametrize("seed", range(3, 20))
 def test_crash_matrix_seed_sweep(tmp_path, window, seed):
     """Wider jitter sweep of the same windows (excluded from tier-1)."""
     _run_window(tmp_path, window, seed)
+
+
+# --------------------------------------------------- lifecycle windows
+
+
+def _take_to_completion_or_kill(script: str, args, timeout=150, env=None):
+    """Run a child snippet; return (returncode, output)."""
+    full_env = dict(os.environ, JAX_PLATFORMS="cpu", **(env or {}))
+    proc = subprocess.run(
+        [sys.executable, "-c", script, *args],
+        env=full_env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=timeout,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    return proc.returncode, proc.stdout
+
+
+_SALVAGE_CHILD = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from tpusnap import Snapshot, StateDict
+
+path, seed, crash_at = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+os.environ["TPUSNAP_DISABLE_BATCHING"] = "1"
+state = {
+    f"w{i}": np.random.default_rng(seed * 1000 + i)
+    .standard_normal((256, 256))
+    .astype(np.float32)
+    for i in range(12)
+}
+# Deterministic SIGKILL after the Nth successful blob write — the
+# chaos layer's registered crash point, no monkeypatching.
+Snapshot.take(
+    "chaos+fs://" + path,
+    {"app": StateDict(**state)},
+    storage_options={"fault_plan": {"seed": seed, "crash_after_op": ("write", crash_at)}},
+)
+print("UNEXPECTED_COMPLETION", flush=True)
+"""
+
+
+@pytest.mark.soak
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed,crash_at", [(0, 8), (1, 10)])
+def test_salvage_resume_of_torn_take(tmp_path, seed, crash_at):
+    """SIGKILL a take after N blob writes, then retake the same path with
+    the same state: fsck classifies the debris as torn, the retake reuses
+    ≥50% of the torn take's intact bytes (asserted via the
+    salvaged-bytes counter in the committed rollup), the result restores
+    bit-exact and scrubs clean, and a sibling committed snapshot is
+    untouched throughout."""
+    from tpusnap.knobs import override_batching_disabled
+    from tpusnap.lifecycle import fsck_snapshot
+
+    path = str(tmp_path / "snap")
+    sibling = str(tmp_path / "sibling")
+    expected = _expected_state(seed)
+    with override_batching_disabled(True):
+        Snapshot.take(sibling, {"app": StateDict(**expected)})
+
+        rc, out = _take_to_completion_or_kill(
+            _SALVAGE_CHILD, [path, str(seed), str(crash_at)]
+        )
+        assert rc == -signal.SIGKILL, (rc, out[-2000:])
+
+        report = fsck_snapshot(path)
+        assert report.state == "torn", report.summary()
+        # Record flushes coalesce under concurrent writes, so the count
+        # can trail the kill point by a few — but never collapse.
+        assert report.salvage_records >= crash_at // 2, report.summary()
+        assert report.salvage_bytes_present > 0
+
+        # Salvage-retake in this process so the counters are observable
+        # both live and in the committed rollup.
+        import tpusnap.telemetry as telemetry
+
+        before = telemetry.counter_value("salvage.bytes_salvaged")
+        Snapshot.take(path, {"app": StateDict(**expected)})
+        salvaged = telemetry.counter_value("salvage.bytes_salvaged") - before
+        assert salvaged >= 0.5 * report.salvage_bytes_present, (
+            salvaged,
+            report.salvage_bytes_present,
+        )
+        rollup = (Snapshot(path).metadata.extras or {}).get("telemetry", {})
+        assert rollup.get("counters", {}).get("salvage.bytes_salvaged", 0) == salvaged
+
+    assert fsck_snapshot(path).state == "committed"
+    target = {
+        "app": StateDict(**{k: np.zeros_like(v) for k, v in expected.items()})
+    }
+    Snapshot(path).restore(target)
+    for k, v in expected.items():
+        assert np.array_equal(target["app"][k], v), k
+    assert verify_snapshot(path).clean
+    # The sibling committed snapshot was never touched.
+    assert fsck_snapshot(sibling).state == "committed"
+    assert verify_snapshot(sibling).clean
+
+
+_GC_CHILD = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+path = sys.argv[1]
+import tpusnap.storage_plugins.fs as fs_mod
+
+orig_delete = fs_mod.FSStoragePlugin.delete
+calls = [0]
+async def slow_delete(self, p):
+    calls[0] += 1
+    if calls[0] == 2:
+        print("MARK", flush=True)
+        time.sleep(1.2)
+    await orig_delete(self, p)
+fs_mod.FSStoragePlugin.delete = slow_delete
+
+from tpusnap.lifecycle import gc_snapshot
+gc_snapshot(path, dry_run=False)
+print("DONE", flush=True)
+"""
+
+
+@pytest.mark.soak
+def test_crash_mid_gc(tmp_path):
+    """SIGKILL inside gc's delete loop: the snapshot stays committed and
+    bit-exact, already-deleted orphans stay gone, and a second gc
+    reclaims exactly the survivors."""
+    import select
+
+    from tpusnap.lifecycle import fsck_snapshot, gc_snapshot
+
+    path = str(tmp_path / "snap")
+    expected = _expected_state(0)
+    Snapshot.take(path, {"app": StateDict(**expected)})
+    orphans = {f"orphan_{i}.blob": 1000 + i for i in range(5)}
+    for name, size in orphans.items():
+        with open(os.path.join(path, name), "wb") as f:
+            f.write(b"x" * size)
+    report = fsck_snapshot(path)
+    assert set(report.orphans) == set(orphans)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _GC_CHILD, path],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        buf = ""
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and "MARK" not in buf:
+            ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+            if not ready:
+                continue
+            chunk = os.read(proc.stdout.fileno(), 4096).decode(
+                "utf-8", errors="replace"
+            )
+            if chunk == "":
+                break
+            buf += chunk
+        assert "MARK" in buf, buf[-2000:]
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+
+    # Mid-GC crash: committed and clean, some orphans possibly gone.
+    report = fsck_snapshot(path)
+    assert report.state == "committed", report.summary()
+    assert not report.missing_referenced
+    remaining = set(report.orphans)
+    assert remaining <= set(orphans)
+    assert verify_snapshot(path).clean
+    # Second gc reclaims exactly the survivors.
+    g = gc_snapshot(path, dry_run=False)
+    assert set(g.reclaimed) == remaining and not g.errors
+    assert not fsck_snapshot(path).orphans
+    target = {
+        "app": StateDict(**{k: np.zeros_like(v) for k, v in expected.items()})
+    }
+    Snapshot(path).restore(target)
+    for k, v in expected.items():
+        assert np.array_equal(target["app"][k], v), k
+
+
+_MATERIALIZE_CHILD = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+path = sys.argv[1]
+import tpusnap.storage_plugins.fs as fs_mod
+
+orig_write = fs_mod.FSStoragePlugin.write
+fired = [False]
+async def slow_write(self, write_io):
+    if not fired[0]:
+        fired[0] = True
+        print("MARK", flush=True)
+        time.sleep(1.2)
+    await orig_write(self, write_io)
+fs_mod.FSStoragePlugin.write = slow_write
+
+from tpusnap.inspect import materialize_snapshot
+materialize_snapshot(path)
+print("DONE", flush=True)
+"""
+
+_RETAIN_CHILD = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+root = sys.argv[1]
+import tpusnap.retention as ret_mod
+
+orig_rmtree = ret_mod.shutil.rmtree
+def slow_rmtree(p, *a, **k):
+    print("MARK", flush=True)
+    time.sleep(1.2)
+    return orig_rmtree(p, *a, **k)
+ret_mod.shutil.rmtree = slow_rmtree
+
+from tpusnap.retention import apply_retention
+apply_retention(root, 2)
+print("DONE", flush=True)
+"""
+
+
+def _run_marked_child(script, args, timeout=120):
+    """Start a child, wait for MARK, SIGKILL at a short delay."""
+    import select
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script, *args],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        buf = ""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and "MARK" not in buf:
+            ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+            if not ready:
+                continue
+            chunk = os.read(proc.stdout.fileno(), 4096).decode(
+                "utf-8", errors="replace"
+            )
+            if chunk == "":
+                break
+            buf += chunk
+        assert "MARK" in buf, buf[-2000:]
+        time.sleep(0.3)
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=60)
+        return buf
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+
+
+def _restorable(path, expected):
+    target = {
+        "app": StateDict(**{k: np.zeros_like(v) for k, v in expected.items()})
+    }
+    Snapshot(path).restore(target)
+    for k, v in expected.items():
+        assert np.array_equal(target["app"][k], v), k
+
+
+@pytest.mark.soak
+def test_crash_mid_materialize(tmp_path):
+    """SIGKILL inside materialize's blob-copy phase: the increment stays
+    committed and still references its (intact) base; the half-copied
+    blobs are fsck-visible orphans gc can reclaim; a retried materialize
+    completes and cuts the base references."""
+    from tpusnap.lifecycle import fsck_snapshot, gc_snapshot
+
+    base = str(tmp_path / "base")
+    inc = str(tmp_path / "inc")
+    state = _expected_state(1)
+    Snapshot.take(base, {"app": StateDict(**state)})
+    changed = dict(state, w0=state["w0"] + 1.0)
+    Snapshot.take(inc, {"app": StateDict(**changed)}, incremental_from=base)
+    assert Snapshot(inc).metadata.base_roots, "increment must reference base"
+
+    _run_marked_child(_MATERIALIZE_CHILD, [inc])
+
+    # Mid-copy crash: both snapshots still committed; the increment
+    # still references the base (manifest not rewritten) and restores.
+    for p in (base, inc):
+        report = fsck_snapshot(p)
+        assert report.state == "committed", (p, report.summary())
+        assert not report.missing_referenced
+    assert Snapshot(inc).metadata.base_roots, "references must survive the crash"
+    _restorable(inc, changed)
+    # Partially copied blobs are unreferenced orphans; reclaim them.
+    gc_snapshot(inc, dry_run=False)
+    # Retry completes.
+    from tpusnap.inspect import materialize_snapshot
+
+    stats = materialize_snapshot(inc)
+    assert stats["blobs_copied"] > 0
+    assert Snapshot(inc).metadata.base_roots is None
+    _restorable(inc, changed)
+    assert verify_snapshot(inc).clean
+    assert not fsck_snapshot(inc).missing_referenced
+
+
+@pytest.mark.soak
+def test_crash_mid_retention(tmp_path):
+    """SIGKILL between retention's materialize phase and its deletions:
+    no kept increment may ever reference a deleted base. After the
+    crash every kept snapshot restores; a re-run converges."""
+    from tpusnap.lifecycle import fsck_snapshot
+    from tpusnap.retention import apply_retention
+
+    root = tmp_path / "snaps"
+    root.mkdir()
+    s1, s2, s3 = (str(root / f"s{i}") for i in (1, 2, 3))
+    state = _expected_state(2)
+    Snapshot.take(s1, {"app": StateDict(**state)})
+    changed = dict(state, w1=state["w1"] * 2.0)
+    Snapshot.take(s2, {"app": StateDict(**changed)}, incremental_from=s1)
+    state3 = dict(state, w2=state["w2"] - 3.0)
+    Snapshot.take(s3, {"app": StateDict(**state3)})
+
+    _run_marked_child(_RETAIN_CHILD, [str(root)])
+
+    # Whatever the crash point: every surviving committed snapshot must
+    # restore — in particular s2, whose base s1 was doomed. Retention
+    # materializes BEFORE deleting, so s2 is either still base-backed
+    # (s1 present) or already self-contained.
+    assert os.path.exists(os.path.join(s2, ".snapshot_metadata"))
+    report = fsck_snapshot(s2)
+    assert report.state == "committed"
+    assert not report.missing_referenced, report.summary()
+    if Snapshot(s2).metadata.base_roots:
+        assert os.path.exists(os.path.join(s1, ".snapshot_metadata")), (
+            "kept increment references a deleted base"
+        )
+    _restorable(s2, changed)
+    _restorable(s3, state3)
+    # Re-run converges: 2 snapshots kept, everything restorable.
+    apply_retention(str(root), 2)
+    assert sorted(os.listdir(root)) == ["s2", "s3"]
+    _restorable(s2, changed)
+    _restorable(s3, state3)
+    assert verify_snapshot(s2).clean and verify_snapshot(s3).clean
 
 
 # ---------------------------------------------------------------- abort

@@ -202,6 +202,9 @@ def test_unknown_fields_are_ignorable(tmp_path):
     md["future_top_level"] = {"x": 1}
     for e in md["manifest"].values():
         e["future_field"] = "ignored"
+    # Per the format spec: a tool that rewrites the metadata must strip
+    # (or recompute) self_checksum — it covers the exact file bytes.
+    md.pop("self_checksum", None)
     json.dump(md, open(meta_path, "w"))
 
     target = {"a": StateDict(w=np.zeros(64, np.float32), n=0)}

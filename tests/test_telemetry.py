@@ -312,6 +312,9 @@ def test_trace_cli_no_telemetry_exits_3(tmp_path, capsys):
     # Strip the always-on rollup too: simulate a pre-telemetry snapshot.
     meta = json.load(open(os.path.join(path, ".snapshot_metadata")))
     meta.pop("extras", None)
+    # Rewriting the file invalidates its self-checksum; per the format
+    # spec a rewriter strips (or recomputes) the field.
+    meta.pop("self_checksum", None)
     with open(os.path.join(path, ".snapshot_metadata"), "w") as f:
         json.dump(meta, f)
     del snap

@@ -30,6 +30,13 @@ try:  # pragma: no cover - import surface grows as modules land
     )
     from .rss_profiler import measure_rss_deltas  # noqa: F401
     from .inspect import ScrubReport, verify_snapshot  # noqa: F401
+    from .lifecycle import (  # noqa: F401
+        FsckReport,
+        GCReport,
+        fsck_snapshot,
+        gc_snapshot,
+    )
+    from .manifest import MetadataError  # noqa: F401
     from .dist_store import TakeAbortedError  # noqa: F401
     from .retry import RetryPolicy  # noqa: F401
     from .faults import FaultPlan, InjectedFaultError  # noqa: F401
@@ -45,6 +52,11 @@ try:  # pragma: no cover - import surface grows as modules land
         "unregister_metrics_sink",
         "ScrubReport",
         "verify_snapshot",
+        "FsckReport",
+        "GCReport",
+        "fsck_snapshot",
+        "gc_snapshot",
+        "MetadataError",
         "TakeAbortedError",
         "RetryPolicy",
         "FaultPlan",

@@ -19,6 +19,16 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
   retain ROOT --keep N  keep the newest N snapshots under ROOT; any kept
                         increment referencing a doomed base is
                         materialized first, then the rest are deleted
+  fsck        PATH      classify the directory (committed / torn / empty /
+                        corrupt-metadata / foreign) from the take journal
+                        + self-checksummed metadata, and enumerate orphan
+                        blobs unreferenced by the manifest (exit 0 =
+                        committed, 2 = corrupt-metadata, 4 = torn, 3 =
+                        empty/foreign)
+  gc          PATH      reclaim orphan blobs (dry-run by default; --force
+                        deletes; --torn additionally discards a torn
+                        take's salvageable blobs). Safe concurrently with
+                        readers: orphans are never referenced
   trace       PATH      render the take's telemetry (per-stage timings,
                         counters, cross-rank rollup) from the traces
                         persisted under .tpusnap/telemetry/ and the
@@ -28,7 +38,8 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
 
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
 (or provably-different diff), 3 undecidable/unverifiable (or no
-telemetry recorded).
+telemetry recorded; fsck: empty/foreign), 4 torn take (fsck —
+salvageable by retaking the path).
 """
 
 from __future__ import annotations
@@ -219,6 +230,52 @@ def cmd_retain(args) -> int:
     return 0
 
 
+def cmd_fsck(args) -> int:
+    from .lifecycle import fsck_snapshot
+
+    report = fsck_snapshot(args.path)
+    print(report.summary())
+    if report.journal is not None and report.state == "torn":
+        import datetime
+
+        ts = datetime.datetime.fromtimestamp(
+            report.journal.started_at, tz=datetime.timezone.utc
+        )
+        print(f"  take started: {ts.isoformat(timespec='seconds')}")
+        if report.journal.incremental_from:
+            print(f"  incremental_from: {report.journal.incremental_from}")
+    if args.verbose:
+        for p in report.missing_referenced:
+            print(f"MISSING  {p}")
+        for p, sz in sorted(report.orphans.items()):
+            print(f"ORPHAN   {_fmt_bytes(sz):>10s}  {p}")
+    # committed→0; corrupt-metadata→2 (corruption, like verify); torn→4
+    # (salvageable — retake the path or `gc --torn`); empty/foreign→3
+    # (nothing tpusnap-shaped to check).
+    if report.state == "committed":
+        return 2 if report.missing_referenced else 0
+    if report.state == "corrupt-metadata":
+        return 2
+    if report.state == "torn":
+        return 4
+    return 3
+
+
+def cmd_gc(args) -> int:
+    from .lifecycle import gc_snapshot
+
+    report = gc_snapshot(
+        args.path, dry_run=not args.force, reclaim_torn=args.torn
+    )
+    would = "" if args.force else "would "
+    for p, sz in sorted(report.reclaimed.items()):
+        print(f"{would}delete  {_fmt_bytes(sz):>10s}  {p}")
+    for err in report.errors:
+        print(f"error: {err}", file=sys.stderr)
+    print(report.summary())
+    return 1 if report.errors else 0
+
+
 def _fmt_seconds(s) -> str:
     if s is None:
         return "-"
@@ -390,6 +447,32 @@ def main(argv=None) -> int:
         help="also print rank K's per-stage detail",
     )
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "fsck",
+        help="classify a snapshot directory (committed/torn/empty/"
+        "corrupt-metadata/foreign) and enumerate orphan blobs",
+    )
+    p.add_argument("path")
+    p.add_argument(
+        "-v", "--verbose", action="store_true",
+        help="list each orphan/missing file",
+    )
+    p.set_defaults(fn=cmd_fsck)
+
+    p = sub.add_parser(
+        "gc",
+        help="reclaim orphan blobs (dry-run unless --force)",
+    )
+    p.add_argument("path")
+    p.add_argument(
+        "--force", action="store_true", help="actually delete (default: dry-run)"
+    )
+    p.add_argument(
+        "--torn", action="store_true",
+        help="also discard a TORN take's blobs (forfeits salvage-resume)",
+    )
+    p.set_defaults(fn=cmd_gc)
 
     p = sub.add_parser(
         "retain",

@@ -178,6 +178,20 @@ class FaultInjectionStoragePlugin(StoragePlugin):
 
     # --- fault decisions --------------------------------------------------
 
+    @staticmethod
+    def _kind_for(kind: str, path: str) -> str:
+        """Ops on lifecycle-journal sidecars count under their own kind
+        (``journal``) so crash-matrix specs can SIGKILL around journal
+        writes by name (``crash_after_op=journal:1``) without the index
+        arithmetic drifting as blob counts change; everything else keeps
+        the raw op kind. ``list`` (fsck/gc enumeration) is already its
+        own kind."""
+        from .lifecycle import is_journal_path
+
+        if is_journal_path(path):
+            return "journal"
+        return kind
+
     def _decide(self, kind: str, path: str) -> Tuple[bool, float]:
         """One decision per op attempt: (inject_transient, latency)."""
         plan, st = self.plan, self._state
@@ -244,7 +258,8 @@ class FaultInjectionStoragePlugin(StoragePlugin):
     # --- plugin interface -------------------------------------------------
 
     async def write(self, write_io: WriteIO) -> None:
-        if await self._pre("write", write_io.path):
+        kind = self._kind_for("write", write_io.path)
+        if await self._pre(kind, write_io.path):
             if self.plan.torn_writes and len(write_io.buf) > 0:
                 keep = self._torn_len(len(write_io.buf))
                 torn = memoryview(write_io.buf).cast("B")[:keep]
@@ -258,10 +273,11 @@ class FaultInjectionStoragePlugin(StoragePlugin):
                 )
             raise InjectedFaultError(f"injected write failure: {write_io.path!r}")
         await self.inner.write(write_io)
-        self._record_success("write")
+        self._record_success(kind)
 
     async def write_atomic(self, write_io: WriteIO, durable: bool = False) -> None:
-        if await self._pre("write_atomic", write_io.path):
+        kind = self._kind_for("write_atomic", write_io.path)
+        if await self._pre(kind, write_io.path):
             # Never tear an atomic write: the wrapped plugin's contract is
             # that a failed write_atomic leaves no trace, and chaos must
             # not fabricate failures the real backend cannot produce.
@@ -269,7 +285,7 @@ class FaultInjectionStoragePlugin(StoragePlugin):
                 f"injected write_atomic failure: {write_io.path!r}"
             )
         await self.inner.write_atomic(write_io, durable=durable)
-        self._record_success("write_atomic")
+        self._record_success(kind)
 
     async def read(self, read_io: ReadIO) -> None:
         if await self._pre("read", read_io.path):
@@ -293,10 +309,21 @@ class FaultInjectionStoragePlugin(StoragePlugin):
         self._record_success("read")
 
     async def delete(self, path: str) -> None:
-        if await self._pre("delete", path):
+        kind = self._kind_for("delete", path)
+        if await self._pre(kind, path):
             raise InjectedFaultError(f"injected delete failure: {path!r}")
         await self.inner.delete(path)
-        self._record_success("delete")
+        self._record_success(kind)
+
+    async def list_with_sizes(self):
+        # fsck/gc's enumeration is a faultable op of its own kind, so
+        # soaks can target lifecycle tooling (``crash_after_op=list:1``,
+        # transient faults on listing) by name.
+        if await self._pre("list", ""):
+            raise InjectedFaultError("injected list failure")
+        out = await self.inner.list_with_sizes()
+        self._record_success("list")
+        return out
 
     async def flush_created_dirs(self) -> None:
         await self.inner.flush_created_dirs()

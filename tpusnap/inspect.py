@@ -371,6 +371,7 @@ def materialize_snapshot(
                     f"base: {detail}"
                 )
 
+            from .manifest import encode_metadata
             from .snapshot import SNAPSHOT_METADATA_FNAME
 
             metadata.base_roots = None  # self-contained now
@@ -380,7 +381,7 @@ def materialize_snapshot(
             storage.sync_write_atomic(
                 WriteIO(
                     path=SNAPSHOT_METADATA_FNAME,
-                    buf=metadata.to_yaml().encode("utf-8"),
+                    buf=encode_metadata(metadata),
                 ),
                 event_loop,
                 durable=True,
@@ -532,15 +533,18 @@ def _read_metadata(
     (the one shared metadata-loading block for scrub/materialize/diff)."""
     from .snapshot import SNAPSHOT_METADATA_FNAME
 
+    from .manifest import decode_metadata
+
     read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
     try:
         storage.sync_read(read_io, event_loop)
     except Exception as e:
         raise RuntimeError(
             f"Failed to read snapshot metadata at {path} — not a "
-            "snapshot, or an aborted/incomplete one"
+            "snapshot, or an aborted/incomplete one (run "
+            f"`python -m tpusnap fsck` to classify)"
         ) from e
-    return SnapshotMetadata.from_yaml(read_io.buf.getvalue().decode("utf-8"))
+    return decode_metadata(read_io.buf.getvalue())
 
 
 def load_snapshot_metadata(

@@ -219,6 +219,20 @@ class StoragePlugin(abc.ABC):
     @abc.abstractmethod
     async def delete(self, path: str) -> None: ...
 
+    async def list_with_sizes(self) -> Optional[dict]:
+        """Enumerate every object under this plugin's root as
+        ``{relative_path: size_bytes}``, or ``None`` when the backend
+        cannot list (the default). Powers offline lifecycle tooling —
+        ``fsck``'s orphan-blob enumeration and ``gc``'s reclamation —
+        which degrade gracefully (no orphan scan) on backends without
+        it. Filesystem plugins implement it with a directory walk."""
+        return None
+
+    def sync_list_with_sizes(
+        self, event_loop: Optional[asyncio.AbstractEventLoop] = None
+    ) -> Optional[dict]:
+        return _run(self.list_with_sizes(), event_loop)
+
     async def flush_created_dirs(self) -> None:
         """Make the dirents of everything this plugin instance created
         durable (fs: fsync each created directory). Called by EVERY rank
@@ -290,11 +304,10 @@ def run_on_loop(event_loop: asyncio.AbstractEventLoop, coro):
         raise
 
 
-def _run(coro, event_loop: Optional[asyncio.AbstractEventLoop]) -> None:
+def _run(coro, event_loop: Optional[asyncio.AbstractEventLoop]):
     if event_loop is not None:
-        run_on_loop(event_loop, coro)
-    else:
-        asyncio.run(coro)
+        return run_on_loop(event_loop, coro)
+    return asyncio.run(coro)
 
 
 def read_io_bytes(read_io: ReadIO) -> memoryview:

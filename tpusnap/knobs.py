@@ -30,6 +30,7 @@ _SCRUB_CONCURRENCY_ENV_VAR = "TPUSNAP_SCRUB_CONCURRENCY"
 _RECORD_DEDUP_HASHES_ENV_VAR = "TPUSNAP_RECORD_DEDUP_HASHES"
 _DURABLE_COMMIT_ENV_VAR = "TPUSNAP_DURABLE_COMMIT"
 _TELEMETRY_ENV_VAR = "TPUSNAP_TELEMETRY"
+_DISABLE_JOURNAL_ENV_VAR = "TPUSNAP_DISABLE_JOURNAL"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -154,6 +155,18 @@ def is_dedup_hash_recording_forced() -> bool:
     return os.environ.get(_RECORD_DEDUP_HASHES_ENV_VAR, "0") == "1"
 
 
+def is_journal_disabled() -> bool:
+    """Crash-safe take journal (:mod:`tpusnap.lifecycle`): on by default
+    — rank 0 marks the take before any blob write (so fsck can classify
+    a SIGKILLed take) and every rank records per-blob completion hashes
+    (the salvage-resume evidence; one fused CRC32C+XXH64 pass per
+    non-slab blob on the write path, overlapped with storage I/O on a
+    worker thread). ``TPUSNAP_DISABLE_JOURNAL=1`` turns the whole layer
+    off for maximum-throughput A/B benchmarking: crashed takes then
+    classify as foreign and retakes restart from byte zero."""
+    return os.environ.get(_DISABLE_JOURNAL_ENV_VAR, "0") == "1"
+
+
 def is_telemetry_enabled() -> bool:
     """Per-take SPAN capture + persisted Chrome traces
     (:mod:`tpusnap.telemetry`): on by default — the disabled path of a
@@ -264,4 +277,10 @@ def override_record_dedup_hashes(enabled: bool) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_telemetry_enabled(enabled: bool) -> Generator[None, None, None]:
     with _override_env(_TELEMETRY_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_journal_disabled(disabled: bool) -> Generator[None, None, None]:
+    with _override_env(_DISABLE_JOURNAL_ENV_VAR, "1" if disabled else "0"):
         yield

@@ -38,6 +38,13 @@ import yaml
 Manifest = Dict[str, "Entry"]
 
 
+class MetadataError(RuntimeError):
+    """The ``.snapshot_metadata`` file is torn or bit-rotted: it fails
+    its self-checksum, is not valid UTF-8, or does not parse. Raised
+    instead of a bare JSON/Unicode traceback so operators see a
+    storage-integrity verdict, not a parser internals dump."""
+
+
 @dataclass
 class Entry:
     """Base for all manifest entries; ``type`` is the tagged-union key."""
@@ -500,4 +507,103 @@ class SnapshotMetadata:
             d = json.loads(s)
         except json.JSONDecodeError:
             d = yaml.safe_load(s)
+        if "self_checksum" in d:
+            d = {k: v for k, v in d.items() if k != "self_checksum"}
         return cls.from_dict(d)
+
+
+# ------------------------------------------------- durable metadata encoding
+
+_SELF_CHECKSUM_KEY = "self_checksum"
+
+
+def encode_metadata(metadata: SnapshotMetadata) -> bytes:
+    """Serialize metadata WITH a self-checksum: the document is plain
+    JSON (external tooling keeps working with ``json.load``) whose FIRST
+    key is ``self_checksum`` — ``"<algo>:<8-hex>"`` over the exact file
+    bytes with the checksum value replaced by zeros. Readers that don't
+    know the field ignore it; :func:`decode_metadata` verifies it, so a
+    torn or bit-rotted metadata file is detected instead of silently
+    parsed (or dumped as a JSON traceback)."""
+    from . import _native
+
+    algo = _native.checksum_algorithm()
+    placeholder = f"{algo}:" + "0" * 8
+    d = {_SELF_CHECKSUM_KEY: placeholder, **metadata.to_dict()}
+    body = json.dumps(d, sort_keys=False)
+    crc = _native.crc32c(body.encode("utf-8")) & 0xFFFFFFFF
+    # The self_checksum field is the document's first key, so the first
+    # occurrence of the placeholder is the field itself; the replacement
+    # is byte-length-preserving, keeping the checksum definition exact.
+    return body.replace(placeholder, f"{algo}:{crc:08x}", 1).encode("utf-8")
+
+
+def decode_metadata(data: bytes) -> SnapshotMetadata:
+    """Parse ``.snapshot_metadata`` bytes, verifying the self-checksum
+    when present (files written before the field verify nothing; an
+    algorithm mismatch across builds is skipped with a warning, matching
+    blob-checksum policy). Raises :class:`MetadataError` on torn or
+    bit-rotted content."""
+    import logging
+
+    from . import _native
+
+    try:
+        text = data.decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise MetadataError(
+            f"snapshot metadata is not valid UTF-8 ({e}) — the file is "
+            "torn or bit-rotted"
+        ) from None
+    try:
+        d = json.loads(text)
+    except json.JSONDecodeError:
+        try:
+            d = yaml.safe_load(text)
+        except yaml.YAMLError:
+            d = None
+    if not isinstance(d, dict):
+        # Covers valid-but-wrong-shape parses too (a corrupted file whose
+        # bytes happen to be a JSON array/scalar) — still a storage-
+        # integrity verdict, never a parser traceback.
+        raise MetadataError(
+            "snapshot metadata does not parse as a JSON/YAML mapping — "
+            "the file is torn (partial write) or corrupted"
+        ) from None
+    recorded = d.get(_SELF_CHECKSUM_KEY)
+    # Only the canonical JSON encoding (self_checksum first) defines the
+    # checksummed byte stream; YAML-reformatted copies skip verification.
+    if isinstance(recorded, str) and text.startswith(
+        '{"%s": ' % _SELF_CHECKSUM_KEY
+    ):
+        algo, _, value = recorded.partition(":")
+        if algo != _native.checksum_algorithm():
+            logging.getLogger(__name__).warning(
+                "skipping metadata self-checksum verification: file used "
+                "%s, this build computes %s",
+                algo,
+                _native.checksum_algorithm(),
+            )
+        else:
+            zeroed = text.replace(recorded, f"{algo}:" + "0" * 8, 1)
+            actual = _native.crc32c(zeroed.encode("utf-8")) & 0xFFFFFFFF
+            try:
+                expect = int(value, 16)
+            except ValueError:
+                raise MetadataError(
+                    f"malformed metadata self-checksum {recorded!r}"
+                ) from None
+            if actual != expect:
+                raise MetadataError(
+                    f"snapshot metadata self-checksum mismatch: recorded "
+                    f"{recorded}, file bytes hash to {algo}:{actual:08x} — "
+                    "the metadata was torn or bit-rotted in storage"
+                )
+    if _SELF_CHECKSUM_KEY in d:
+        d = {k: v for k, v in d.items() if k != _SELF_CHECKSUM_KEY}
+    try:
+        return SnapshotMetadata.from_dict(d)
+    except (KeyError, TypeError, ValueError) as e:
+        raise MetadataError(
+            f"snapshot metadata parses but is structurally invalid ({e!r})"
+        ) from e

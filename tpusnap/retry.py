@@ -291,6 +291,11 @@ class RetryingStoragePlugin(StoragePlugin):
     async def delete(self, path: str) -> None:
         await self._with_retry("delete", path, lambda: self.inner.delete(path))
 
+    async def list_with_sizes(self):
+        return await self._with_retry(
+            "list", "", lambda: self.inner.list_with_sizes()
+        )
+
     async def flush_created_dirs(self) -> None:
         await self.inner.flush_created_dirs()
 

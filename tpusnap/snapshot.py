@@ -285,8 +285,24 @@ class Snapshot:
                 abort_ctx.mark_commit_started()
                 _write_metadata(storage, metadata, event_loop)
             comm.barrier()
-            if comm.rank == 0 and abort_ctx.monitor is not None:
-                abort_ctx.monitor.clear()
+            if comm.rank == 0:
+                # Metadata committed and every rank departed: the take
+                # journal's job is done. Best-effort — a crash before
+                # this clear leaves valid metadata + a stale journal,
+                # which fsck classifies as committed (gc reclaims the
+                # leftovers). Cleared strictly AFTER the metadata write,
+                # preserving metadata-written-last.
+                from .knobs import is_journal_disabled
+                from .lifecycle import clear_journal
+
+                if not is_journal_disabled():
+                    clear_journal(
+                        storage,
+                        event_loop,
+                        getattr(storage, "clear_world_size", comm.world_size),
+                    )
+                if abort_ctx.monitor is not None:
+                    abort_ctx.monitor.clear()
             storage.sync_close(event_loop)
         except BaseException as e:
             abort_ctx.on_failure(e)
@@ -562,10 +578,16 @@ class Snapshot:
                 f"{self.path}/{SNAPSHOT_METADATA_FNAME} — not a snapshot, or "
                 f"an aborted/incomplete one"
             ) from e
+        from .manifest import MetadataError, decode_metadata
+
         try:
-            self._metadata = SnapshotMetadata.from_yaml(
-                read_io.buf.getvalue().decode("utf-8")
-            )
+            self._metadata = decode_metadata(read_io.buf.getvalue())
+        except MetadataError as e:
+            raise RuntimeError(
+                f"Corrupt snapshot metadata at "
+                f"{self.path}/{SNAPSHOT_METADATA_FNAME}: {e} — run "
+                f"`python -m tpusnap fsck {self.path}` to classify"
+            ) from e
         except Exception as e:
             raise RuntimeError(
                 f"Corrupt snapshot metadata at "
@@ -601,6 +623,10 @@ class _TakeAbortContext:
         self.late_checksums: Optional["_LateChecksums"] = None
         self.tele_commit: Optional["_TelemetryCommit"] = None
         self.commit_started = False
+        # Set once the take's journal exists: an ABORTED take (as opposed
+        # to a SIGKILLed one) cleans its blobs, so it also clears the
+        # journal — leaving the path classifiably empty, not torn.
+        self.journal_world_size: Optional[int] = None
 
     def arm(self, monitor: TakeAbortMonitor) -> None:
         self.monitor = monitor
@@ -627,9 +653,37 @@ class _TakeAbortContext:
             and self.storage is not None
             and self.event_loop is not None
         ):
+            deletes_failed = False
             for path in self.write_paths:
                 try:
                     self.storage.sync_delete(path, self.event_loop)
+                except FileNotFoundError:
+                    pass  # dedup/salvage-skipped or never-written path
+                except Exception:
+                    deletes_failed = True
+            # Blobs gone: clear this rank's journal records (rank 0 also
+            # the marker) so the path reads as empty, not torn. Records
+            # go before the marker — a crash mid-cleanup stays torn. If
+            # any of THIS rank's blob deletions failed, keep the marker
+            # too: the leftovers must stay classifiable as torn (gc
+            # --torn can finish the job), not become foreign debris.
+            # Best-effort only across ranks — a PEER whose cleanup fails
+            # after rank 0 cleared the marker still leaves foreign
+            # files; that residual case needs a manual delete.
+            if self.journal_world_size is not None:
+                from .lifecycle import clear_journal, journal_rank_path
+
+                try:
+                    if self.comm.rank != 0:
+                        self.storage.sync_delete(
+                            journal_rank_path(self.comm.rank), self.event_loop
+                        )
+                    elif not deletes_failed:
+                        clear_journal(
+                            self.storage,
+                            self.event_loop,
+                            self.journal_world_size,
+                        )
                 except Exception:
                     pass
         if self.late_checksums is not None:
@@ -820,7 +874,10 @@ def _take_impl(
                 TakeAbortMonitor(_get_kv_store(comm), take_id, rank)
             )
     else:
-        take_id = None
+        # Single-process takes journal under their own id (no KV scoping
+        # needed; _LateChecksums/_TelemetryCommit stay inactive at
+        # world_size == 1 regardless).
+        take_id = uuid.uuid4().hex
         replicated_paths = matched
         traced_geometry = {}
     # The G1 gather + write-load partition plan (single-process: just
@@ -831,8 +888,93 @@ def _take_impl(
     storage = url_to_storage_plugin_in_event_loop(
         path, event_loop, storage_options
     )
+    # Crash-safe lifecycle (tpusnap.lifecycle): if the destination holds
+    # a TORN take (journal present, no committed metadata), load its
+    # completion records — staged blobs whose dual hash matches skip
+    # their storage writes (salvage-resume). Then every rank wraps its
+    # plugin in the journaling layer, and rank 0 writes the journal
+    # marker BEFORE any blob write so a SIGKILLed take stays
+    # distinguishable from a committed snapshot or foreign files.
+    from .lifecycle import (
+        JournalingStoragePlugin,
+        TakeJournal,
+        load_salvage_records,
+        read_journal,
+        write_journal,
+    )
+
+    from .knobs import is_journal_disabled
+
+    journal_enabled = not is_journal_disabled()
+    salvage_records = None
+    # Covers every rank that may hold a journal record at this path: a
+    # retake over a torn take with a LARGER world size must still clear
+    # the torn ranks' record files at commit.
+    journal_clear_ws = comm.world_size
+    prior_journal = (
+        read_journal(storage, event_loop) if journal_enabled else None
+    )
+    if prior_journal is not None:
+        journal_clear_ws = max(journal_clear_ws, prior_journal.world_size)
+        try:
+            files = storage.sync_list_with_sizes(event_loop)
+        except Exception:
+            files = None
+        # Salvage requires a listing (load_salvage_records cross-checks
+        # every record against the blobs actually present — load-bearing
+        # for correctness); it also gives the metadata-existence probe
+        # for free (a committed snapshot with a stale journal must NOT
+        # trigger salvage).
+        if files is not None and SNAPSHOT_METADATA_FNAME not in files:
+            salvage_records = load_salvage_records(
+                storage, event_loop, prior_journal.world_size, files=files
+            )
+            if salvage_records:
+                logger.info(
+                    "Torn take %s found at %r: %d completed blob record(s) "
+                    "loaded for salvage-resume",
+                    prior_journal.take_id[:8],
+                    path,
+                    len(salvage_records),
+                )
+    storage = JournalingStoragePlugin(storage, rank, salvage_records)
+    storage.clear_world_size = journal_clear_ws
+    if journal_enabled:
+        if rank == 0:
+            import time as _time
+
+            write_journal(
+                storage,
+                event_loop,
+                TakeJournal(
+                    take_id=take_id,
+                    world_size=comm.world_size,
+                    started_at=_time.time(),
+                    incremental_from=incremental_from,
+                    version=__version__,
+                ),
+            )
+        # EVERY rank eagerly creates its record file before any of its
+        # blob writes: the journal-before-blobs invariant would
+        # otherwise be rank-0-only — a gang-SIGKILL while a fast peer
+        # wrote blobs before rank 0's marker landed would leave debris
+        # fsck can only call foreign. Any journal-family file counts as
+        # take evidence, so the unclassifiable window shrinks to this
+        # one tiny write per rank. The write carries the SEEDED salvage
+        # records (not an empty map), so a salvage-retake that itself
+        # crashes early still leaves the torn take's evidence for the
+        # third attempt.
+        try:
+            storage.sync_seed_record_file(event_loop)
+        except Exception:
+            logger.warning(
+                "Failed to create journal record file (non-fatal)",
+                exc_info=True,
+            )
     if abort_ctx is not None:
         abort_ctx.storage = storage
+        if journal_enabled:
+            abort_ctx.journal_world_size = journal_clear_ws
 
     # Incremental snapshot: this rank's view of the base snapshot's
     # manifest, blob locations rewritten relative to the NEW root.
@@ -1090,12 +1232,12 @@ def _load_prev_entries(
         incremental_from, event_loop, storage_options
     )
     try:
+        from .manifest import decode_metadata
+
         read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
         try:
             storage.sync_read(read_io, event_loop)
-            prev_md = SnapshotMetadata.from_yaml(
-                read_io.buf.getvalue().decode("utf-8")
-            )
+            prev_md = decode_metadata(read_io.buf.getvalue())
         except Exception as e:
             raise RuntimeError(
                 f"incremental_from={incremental_from!r} is not a readable "
@@ -1445,11 +1587,15 @@ def _write_metadata(
     # fsync after a multi-GB take flushes the storage cache of the whole
     # take (see knobs.is_durable_commit_enabled).
     from .knobs import is_durable_commit_enabled
+    from .manifest import encode_metadata
 
     storage.sync_write_atomic(
         WriteIO(
             path=SNAPSHOT_METADATA_FNAME,
-            buf=metadata.to_yaml().encode("utf-8"),
+            # Self-checksummed (manifest.encode_metadata): restore/fsck
+            # detect a torn or bit-rotted metadata file with a clear
+            # MetadataError instead of a JSON traceback.
+            buf=encode_metadata(metadata),
         ),
         event_loop,
         durable=is_durable_commit_enabled(),
@@ -1739,12 +1885,27 @@ class PendingSnapshot(_BackgroundWork):
                 self._abort_ctx.mark_commit_started()
             _write_metadata(self._storage, self._metadata, self._event_loop)
         self._barrier.depart()
-        if (
-            self._comm.rank == 0
-            and self._abort_ctx is not None
-            and self._abort_ctx.monitor is not None
-        ):
-            self._abort_ctx.monitor.clear()
+        if self._comm.rank == 0:
+            # Commit done (see the sync take's identical step): clear
+            # the take journal, strictly after the metadata write.
+            from .knobs import is_journal_disabled
+            from .lifecycle import clear_journal
+
+            if not is_journal_disabled():
+                clear_journal(
+                    self._storage,
+                    self._event_loop,
+                    getattr(
+                        self._storage,
+                        "clear_world_size",
+                        self._comm.world_size,
+                    ),
+                )
+            if (
+                self._abort_ctx is not None
+                and self._abort_ctx.monitor is not None
+            ):
+                self._abort_ctx.monitor.clear()
         # Every rank departing proves it consumed the take's gathers
         # and the barrier-prefix broadcast; release their KV keys now
         # — no further barrier will run on this communicator, so the

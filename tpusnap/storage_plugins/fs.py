@@ -231,6 +231,29 @@ class FSStoragePlugin(StoragePlugin):
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, os.remove, full)
 
+    async def list_with_sizes(self) -> Optional[dict]:
+        """``{relative_path: size}`` for every regular file under the
+        root (lifecycle tooling: fsck orphan enumeration, gc). Missing
+        root → empty dict (an un-taken snapshot path is simply empty)."""
+        loop = asyncio.get_running_loop()
+
+        def work():
+            out = {}
+            root = os.path.abspath(self.root)
+            if not os.path.isdir(root):
+                return out
+            for dirpath, _dirnames, filenames in os.walk(root):
+                for name in filenames:
+                    full = os.path.join(dirpath, name)
+                    rel = os.path.relpath(full, root).replace(os.sep, "/")
+                    try:
+                        out[rel] = os.path.getsize(full)
+                    except OSError:
+                        continue  # racing deletion (concurrent gc/abort)
+            return out
+
+        return await loop.run_in_executor(self._get_executor(), work)
+
     async def flush_created_dirs(self) -> None:
         """fsync every directory this instance created (durable-commit
         mode: each rank runs this after its writes drain, so dirents of
