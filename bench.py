@@ -159,6 +159,7 @@ def measure_roofline(tmp: str, nbytes_per_file: int, n_files: int) -> float:
 def main() -> None:
     from tpusnap import PytreeState, Snapshot
     from tpusnap import scheduler as _sched
+    from tpusnap import telemetry as _tele
 
     from tpusnap import _native as _natalloc
 
@@ -296,6 +297,7 @@ def main() -> None:
         # 2 GB probe below instead.
         restore_runs = []
         restore_warm_runs = []
+        restore_summaries = []
         warm_target = {
             f"w{i}": np.zeros_like(state[f"w{i}"]) for i in range(N_ARRAYS)
         }
@@ -312,8 +314,28 @@ def main() -> None:
             t0 = time.perf_counter()
             Snapshot(restore_snap).restore(app_state)
             restore_runs.append(time.perf_counter() - t0)
-        restore_el = min(restore_runs)
+            restore_summaries.append(_tele.LAST_RESTORE_SUMMARY)
+        best_restore_i = min(
+            range(len(restore_runs)), key=restore_runs.__getitem__
+        )
+        restore_el = restore_runs[best_restore_i]
         restore_gbps = nbytes / restore_el / 1e9
+        # Restore-path telemetry of the BEST cold restore — the same
+        # phase decomposition the take's stage_breakdown gives, so the
+        # restore headline is diagnosable too (plan vs reads vs load).
+        best_restore_summary = restore_summaries[best_restore_i] or {}
+        restore_stage_breakdown = {
+            "phases_s": {
+                k: round(v, 3)
+                for k, v in (best_restore_summary.get("phases") or {}).items()
+            },
+            "phase_coverage": best_restore_summary.get("phase_coverage"),
+            "counters": {
+                k: v
+                for k, v in (best_restore_summary.get("counters") or {}).items()
+                if not k.startswith("staging_pool.")
+            },
+        }
         # Bit-pattern comparison: random f16 buffers contain NaNs, and
         # NaN != NaN would fail a value comparison on correct data.
         ok = all(
@@ -365,7 +387,6 @@ def main() -> None:
         # (host contention), so roofline and take are sampled INTERLEAVED —
         # comparing a lucky roofline window against an unlucky take window
         # would say "pipeline overhead" where there is only disk noise.
-        from tpusnap import telemetry as _tele
         from tpusnap.rss_profiler import measure_rss_deltas
 
         times = []
@@ -747,6 +768,7 @@ def main() -> None:
                     round(r, 3) for r in restore_rooflines_verified
                 ],
                 "restore_runs_s": [round(t, 2) for t in restore_runs],
+                "restore_stage_breakdown": restore_stage_breakdown,
                 "restore_warm_gbps": round(
                     nbytes / min(restore_warm_runs) / 1e9, 3
                 ),

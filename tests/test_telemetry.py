@@ -18,8 +18,7 @@ from tpusnap import (
     MetricsSink,
     PytreeState,
     Snapshot,
-    register_metrics_sink,
-    unregister_metrics_sink,
+    metrics_sink,
 )
 from tpusnap import telemetry
 from tpusnap.knobs import is_telemetry_enabled, override_telemetry_enabled
@@ -188,12 +187,8 @@ def test_metrics_sink_callbacks(tmp_path):
         def on_take_summary(self, summary):
             seen["summaries"].append(summary)
 
-    sink = Sink()
-    register_metrics_sink(sink)
-    try:
+    with metrics_sink(Sink()):
         Snapshot.take(str(tmp_path / "snap"), {"m": PytreeState(_state())})
-    finally:
-        unregister_metrics_sink(sink)
     assert "stage" in seen["spans"]
     assert "storage.writes" in seen["counters"]
     assert len(seen["summaries"]) == 1
@@ -215,13 +210,30 @@ def test_raising_sink_never_breaks_a_take(tmp_path):
         def on_take_summary(self, summary):
             raise RuntimeError("bad sink")
 
-    sink = BadSink()
-    register_metrics_sink(sink)
-    try:
+    with metrics_sink(BadSink()):
         snap = Snapshot.take(str(tmp_path / "snap"), {"m": PytreeState(_state())})
-    finally:
-        unregister_metrics_sink(sink)
     assert snap.verify().clean
+
+
+def test_metrics_sink_context_manager_unregisters_on_raise():
+    """A failing test body can no longer leak its sink into the
+    process-global tuple (the leak the context manager exists to fix)."""
+    calls = []
+
+    class Sink(MetricsSink):
+        def on_counter(self, name, delta, value):
+            calls.append(name)
+
+    sink = Sink()
+    with pytest.raises(RuntimeError):
+        with metrics_sink(sink) as registered:
+            assert registered is sink
+            telemetry.incr("ctx.mgr.counter")
+            raise RuntimeError("body failed")
+    n = len(calls)
+    assert n >= 1
+    telemetry.incr("ctx.mgr.counter")  # after exit: no callback
+    assert len(calls) == n
 
 
 # ------------------------------------------------- persisted trace files
@@ -320,6 +332,22 @@ def test_trace_cli_no_telemetry_exits_3(tmp_path, capsys):
     del snap
     assert main(["trace", path]) == 3
     assert "no telemetry" in capsys.readouterr().err
+
+
+def test_trace_cli_knob_off_take_exits_3(tmp_path, capsys):
+    """The OTHER no-telemetry case: a knob-off take still rolls up its
+    always-on counters into the extras, but has zero spans anywhere —
+    trace must print the one-line explanation and exit 3 instead of an
+    empty stage table."""
+    from tpusnap.__main__ import main
+
+    path = str(tmp_path / "snap")
+    with override_telemetry_enabled(False):
+        Snapshot.take(path, {"m": PytreeState(_state())})
+    assert main(["trace", path]) == 3
+    captured = capsys.readouterr()
+    assert "no telemetry" in captured.err
+    assert "stage" not in captured.out  # no empty table printed
 
 
 def test_cli_help_lists_trace(capsys):

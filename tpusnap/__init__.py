@@ -42,12 +42,14 @@ try:  # pragma: no cover - import surface grows as modules land
     from .faults import FaultPlan, InjectedFaultError  # noqa: F401
     from .telemetry import (  # noqa: F401
         MetricsSink,
+        metrics_sink,
         register_metrics_sink,
         unregister_metrics_sink,
     )
 
     __all__ += [
         "MetricsSink",
+        "metrics_sink",
         "register_metrics_sink",
         "unregister_metrics_sink",
         "ScrubReport",

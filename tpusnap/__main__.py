@@ -30,11 +30,20 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
                         take's salvageable blobs). Safe concurrently with
                         readers: orphans are never referenced
   trace       PATH      render the take's telemetry (per-stage timings,
-                        counters, cross-rank rollup) from the traces
+                        counters, cross-rank rollup, slowest-rank-per-
+                        phase straggler attribution) from the traces
                         persisted under .tpusnap/telemetry/ and the
                         metadata extras (``--json`` for machines,
-                        ``--rank K`` for one rank's stage detail; exit
-                        3 = no telemetry recorded)
+                        ``--rank K`` for one rank's stage detail;
+                        ``--restore`` renders the LAST restore's traces
+                        from the local TPUSNAP_TELEMETRY_DIR instead;
+                        exit 3 = no telemetry recorded)
+  watch       PATH      tail an IN-FLIGHT take's heartbeat records
+                        (.tpusnap/progress/rank_<k>.json) and render a
+                        live per-rank table (phase, % bytes, MB/s,
+                        stragglers flagged), refreshing in place until
+                        the take commits (``--once``/``--json`` for one
+                        frame; exit 3 = no heartbeat records found)
 
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
 (or provably-different diff), 3 undecidable/unverifiable (or no
@@ -284,42 +293,16 @@ def _fmt_seconds(s) -> str:
     return f"{s * 1e3:.1f}ms"
 
 
-def cmd_trace(args) -> int:
+def _render_trace(args, rollup, summaries, ranks, world_size, label) -> int:
     import json as _json
-
-    from .io_types import ReadIO
-    from .telemetry import rollup_summaries, telemetry_rank_path
-
-    snap = Snapshot(args.path)
-    md = snap.metadata
-    rollup = (md.extras or {}).get("telemetry")
-    ranks: dict = {}
-    with snap._op_lock:
-        event_loop, storage = snap._resources()
-        for rank in range(md.world_size):
-            read_io = ReadIO(path=telemetry_rank_path(rank))
-            try:
-                storage.sync_read(read_io, event_loop)
-                ranks[rank] = _json.loads(read_io.buf.getvalue().decode("utf-8"))
-            except Exception:
-                continue  # telemetry disabled on this rank, or pre-telemetry snapshot
-    summaries = {r: d.get("summary") or {} for r, d in ranks.items()}
-    if rollup is None and summaries:
-        rollup = rollup_summaries(list(summaries.values()))
-    if not rollup and not summaries:
-        print(
-            "no telemetry recorded (taken with TPUSNAP_TELEMETRY=0, or a "
-            "pre-telemetry snapshot)",
-            file=sys.stderr,
-        )
-        return 3
 
     if args.json:
         print(
             _json.dumps(
                 {
                     "path": args.path,
-                    "world_size": md.world_size,
+                    "kind": label,
+                    "world_size": world_size,
                     "rollup": rollup,
                     "ranks": {str(r): s for r, s in sorted(summaries.items())},
                 }
@@ -328,21 +311,44 @@ def cmd_trace(args) -> int:
         return 0
 
     print(f"path:         {args.path}")
-    print(f"world_size:   {md.world_size}")
+    print(f"world_size:   {world_size}")
     print(f"traced ranks: {sorted(ranks) if ranks else '(rollup only)'}")
+    multi = bool(rollup) and rollup.get("ranks", 1) > 1
     if rollup:
-        print(f"take wall-clock (slowest rank): {_fmt_seconds(rollup.get('take_wall_s'))}")
+        print(
+            f"{label} wall-clock (slowest rank): "
+            f"{_fmt_seconds(rollup.get('take_wall_s'))}"
+        )
         cov = rollup.get("phase_coverage_min")
         if cov is not None:
             print(f"phase coverage of wall-clock:   {cov * 100:.1f}%")
         stages = rollup.get("stages") or {}
         if stages:
-            print(f"\n{'stage':<24s} {'ranks':>5s} {'p50':>10s} {'max':>10s}")
+            head = f"\n{'stage':<24s} {'ranks':>5s} {'p50':>10s} {'max':>10s}"
+            print(head + ("  max@" if multi else ""))
             for name, agg in stages.items():
-                print(
+                line = (
                     f"{name:<24s} {agg.get('ranks', 0):>5d} "
                     f"{_fmt_seconds(agg.get('p50_s')):>10s} "
                     f"{_fmt_seconds(agg.get('max_s')):>10s}"
+                )
+                if multi and agg.get("max_rank") is not None:
+                    line += f"  r{agg['max_rank']}"
+                print(line)
+        # Straggler attribution: the slowest rank per PHASE and how far
+        # behind the median it was (the skew the stall watchdog's live
+        # warnings pointed at, made durable).
+        skew = rollup.get("phase_skew") or {}
+        if multi and skew:
+            print("\nstragglers (slowest rank per phase):")
+            for name, agg in skew.items():
+                if not agg.get("max_s"):
+                    continue
+                ratio = agg.get("skew")
+                print(
+                    f"  {name:<22s} rank {agg.get('max_rank')} at "
+                    f"{_fmt_seconds(agg.get('max_s'))}"
+                    + (f" ({ratio:.2f}x the p50)" if ratio else "")
                 )
         counters = rollup.get("counters") or {}
         if counters:
@@ -352,6 +358,9 @@ def cmd_trace(args) -> int:
         bw = rollup.get("bytes_written")
         if bw:
             print(f"\nbytes written:     {_fmt_bytes(bw)}")
+        br = (rollup.get("counters") or {}).get("storage.bytes_read")
+        if br:
+            print(f"bytes read:        {_fmt_bytes(br)}")
         hw = rollup.get("budget_high_water_bytes")
         if hw:
             print(f"budget high-water: {_fmt_bytes(int(hw))}")
@@ -377,6 +386,134 @@ def cmd_trace(args) -> int:
                 f"{_fmt_seconds(agg.get('max_s')):>10s}"
             )
     return 0
+
+
+def cmd_trace(args) -> int:
+    import json as _json
+
+    from .telemetry import rollup_summaries
+
+    if args.restore:
+        from .progress import load_restore_traces, restore_trace_dir
+
+        docs = load_restore_traces(args.path)
+        if not docs:
+            print(
+                "no restore telemetry recorded for this path (no restore "
+                "ran from this machine, TPUSNAP_TELEMETRY=0, or a "
+                f"different TPUSNAP_TELEMETRY_DIR — looked in "
+                f"{restore_trace_dir(args.path)})",
+                file=sys.stderr,
+            )
+            return 3
+        summaries = {r: d.get("summary") or {} for r, d in docs.items()}
+        rollup = rollup_summaries(list(summaries.values()))
+        return _render_trace(
+            args, rollup, summaries, sorted(docs), len(docs), "restore"
+        )
+
+    from .io_types import ReadIO
+    from .telemetry import telemetry_rank_path
+
+    snap = Snapshot(args.path)
+    md = snap.metadata
+    rollup = (md.extras or {}).get("telemetry")
+    ranks: dict = {}
+    with snap._op_lock:
+        event_loop, storage = snap._resources()
+        for rank in range(md.world_size):
+            read_io = ReadIO(path=telemetry_rank_path(rank))
+            try:
+                storage.sync_read(read_io, event_loop)
+                ranks[rank] = _json.loads(read_io.buf.getvalue().decode("utf-8"))
+            except Exception:
+                continue  # telemetry disabled on this rank, or pre-telemetry snapshot
+    summaries = {r: d.get("summary") or {} for r, d in ranks.items()}
+    if rollup is None and summaries:
+        rollup = rollup_summaries(list(summaries.values()))
+    # "No telemetry" covers both the pre-telemetry snapshot (no rollup,
+    # no traces) and the knob-off take (always-on counters rolled up,
+    # but zero spans anywhere): an empty stage table helps nobody —
+    # explain and exit with the dedicated code instead.
+    has_spans = bool((rollup or {}).get("stages")) or any(
+        s.get("stages") for s in summaries.values()
+    )
+    if not summaries and not has_spans:
+        print(
+            "no telemetry recorded (taken with TPUSNAP_TELEMETRY=0, or a "
+            "pre-telemetry snapshot)",
+            file=sys.stderr,
+        )
+        return 3
+    return _render_trace(
+        args, rollup, summaries, sorted(ranks), md.world_size, "take"
+    )
+
+
+def cmd_watch(args) -> int:
+    import json as _json
+    import os
+    import time
+
+    from .progress import (
+        local_root_of,
+        read_progress_records,
+        render_watch_table,
+    )
+
+    root = local_root_of(args.path)
+    if root is None:
+        print(
+            f"error: {args.path!r} is not a local filesystem path — "
+            "`watch` tails the local heartbeat files under "
+            ".tpusnap/progress/",
+            file=sys.stderr,
+        )
+        return 1
+    deadline = (
+        time.monotonic() + args.max_seconds if args.max_seconds else None
+    )
+    seen_records = False
+    commit_seen_at = None
+    prev_lines = 0
+    interactive = sys.stdout.isatty() and not args.once and not args.json
+    while True:
+        records = read_progress_records(root)
+        committed = os.path.exists(os.path.join(root, ".snapshot_metadata"))
+        if records:
+            seen_records = True
+        if args.json:
+            print(
+                _json.dumps(
+                    {"records": records, "metadata_committed": committed}
+                )
+            )
+            return 0 if records else 3
+        frame = render_watch_table(
+            records, committed, stall_flag_s=args.stall_flag
+        )
+        if interactive and prev_lines:
+            # Refresh in place: move the cursor back over the last frame.
+            sys.stdout.write(f"\x1b[{prev_lines}F\x1b[J")
+        print(frame, flush=True)
+        prev_lines = frame.count("\n") + 1
+        if args.once:
+            return 0 if records else 3
+        done = records and all(
+            r.get("state") != "running" for r in records
+        )
+        if done:
+            return 0
+        if committed and seen_records:
+            # Metadata lands a beat before the final 100% heartbeat —
+            # give the publishers a short grace window, then stop.
+            if commit_seen_at is None:
+                commit_seen_at = time.monotonic()
+            elif time.monotonic() - commit_seen_at > 2.0:
+                return 0
+        if deadline is not None and time.monotonic() > deadline:
+            return 0 if seen_records else 3
+        time.sleep(args.interval)
 
 
 def cmd_cat(args) -> int:
@@ -446,7 +583,40 @@ def main(argv=None) -> int:
         "--rank", type=int, default=None, metavar="K",
         help="also print rank K's per-stage detail",
     )
+    p.add_argument(
+        "--restore", action="store_true",
+        help="render the LAST restore's traces (persisted locally under "
+        "TPUSNAP_TELEMETRY_DIR) instead of the take's",
+    )
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "watch",
+        help="live per-rank progress table of an in-flight take "
+        "(tails .tpusnap/progress/ heartbeat records)",
+    )
+    p.add_argument("path")
+    p.add_argument(
+        "--interval", type=float, default=1.0, metavar="S",
+        help="refresh interval in seconds (default 1.0)",
+    )
+    p.add_argument(
+        "--once", action="store_true", help="render one frame and exit"
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print one machine-readable frame and exit",
+    )
+    p.add_argument(
+        "--max-seconds", type=float, default=None, metavar="S",
+        help="give up after S seconds (default: wait for the commit)",
+    )
+    p.add_argument(
+        "--stall-flag", type=float, default=10.0, metavar="S",
+        help="flag a rank as STALLED? after S seconds without a beat "
+        "(default 10)",
+    )
+    p.set_defaults(fn=cmd_watch)
 
     p = sub.add_parser(
         "fsck",

@@ -100,6 +100,16 @@ class Communicator:
     def clear_wait_watcher(self) -> None:
         return None
 
+    def barrier_missing_ranks(self) -> Optional[List[int]]:
+        """While this process is blocked inside a POLLING barrier (the
+        abort-aware mode every multi-process take runs in), the sorted
+        rank ids whose arrive keys are absent — the stall watchdog's
+        straggler attribution. None when not waiting in a barrier, or
+        when the wait mode cannot be introspected (native
+        wait_at_barrier). Called from the watchdog thread: pure KV
+        reads, safe concurrently with the waiting thread's polling."""
+        return None
+
 
 _instance_count = 0
 
@@ -159,6 +169,11 @@ class JaxCoordinationComm(Communicator):
         self._gc_lock = threading.Lock()
         # Optional abort watcher (see Communicator.set_wait_watcher).
         self._wait_watcher = None
+        # ("barrier", prefix) while blocked in a polling barrier — read
+        # by barrier_missing_ranks() from the watchdog thread. A plain
+        # attribute write (GIL-atomic); staleness across the hand-off is
+        # tolerable for a best-effort diagnostic.
+        self._live_wait: Optional[tuple] = None
 
     @property
     def rank(self) -> int:
@@ -280,13 +295,39 @@ class JaxCoordinationComm(Communicator):
         prefix = f"{self._namespace()}/pb{seq}"
         deadline = time.monotonic() + self._timeout_ms / 1000.0
         self._client.key_value_set(f"{prefix}/a/{self._rank}", "1")
-        if self._rank == 0:
-            for r in range(1, self._world_size):
-                self._watched_wait_key(f"{prefix}/a/{r}", deadline)
-            self._client.key_value_set(f"{prefix}/d", "1")
-        else:
-            self._watched_wait_key(f"{prefix}/d", deadline)
+        self._live_wait = ("barrier", prefix)
+        try:
+            if self._rank == 0:
+                for r in range(1, self._world_size):
+                    self._watched_wait_key(f"{prefix}/a/{r}", deadline)
+                self._client.key_value_set(f"{prefix}/d", "1")
+            else:
+                self._watched_wait_key(f"{prefix}/d", deadline)
+        finally:
+            self._live_wait = None
         return prefix
+
+    def barrier_missing_ranks(self) -> Optional[List[int]]:
+        live = self._live_wait
+        if live is None or live[0] != "barrier":
+            return None
+        try:
+            entries = self._client.key_value_dir_get(f"{live[1]}/a")
+        except Exception:
+            return None
+        arrived = set()
+        for key, _value in entries:
+            try:
+                arrived.add(int(key.rsplit("/", 1)[-1]))
+            except ValueError:
+                continue
+        missing = sorted(set(range(self._world_size)) - arrived)
+        if not missing:
+            # Everyone arrived but we are still waiting: a non-leader is
+            # blocked on the depart key, which rank 0 owns — attribute
+            # the stall to the leader (mirrors LinearBarrier).
+            return [0] if self._rank != 0 else None
+        return missing
 
     def gc_epoch(self) -> int:
         with self._gc_lock:

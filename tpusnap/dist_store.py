@@ -359,6 +359,9 @@ class LinearBarrier:
         self.leader_rank = leader_rank
         self.timeout_sec = timeout_sec
         self.watchers = list(watchers or [])
+        # True while blocked inside a _checked_get poll loop — read by
+        # current_missing() from the stall watchdog thread.
+        self._in_wait = False
 
     def _key(self, *parts: str) -> str:
         return "/".join((self.prefix,) + parts)
@@ -388,19 +391,42 @@ class LinearBarrier:
     def _checked_get(self, key: str) -> bytes:
         """Wait for a key while also watching for reported errors."""
         deadline = time.monotonic() + self.timeout_sec
-        while True:
-            value = self.store.try_get(key)
-            if value is not None:
-                return value
-            for watcher in self.watchers:
-                watcher()
-            self._raise_any_reported_error()
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"LinearBarrier {self.prefix!r}: timed out waiting for "
-                    f"{key!r}"
-                )
-            time.sleep(_POLL_INTERVAL_SEC)
+        self._in_wait = True
+        try:
+            while True:
+                value = self.store.try_get(key)
+                if value is not None:
+                    return value
+                for watcher in self.watchers:
+                    watcher()
+                self._raise_any_reported_error()
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"LinearBarrier {self.prefix!r}: timed out waiting for "
+                        f"{key!r}"
+                    )
+                time.sleep(_POLL_INTERVAL_SEC)
+        finally:
+            self._in_wait = False
+
+    def current_missing(self) -> Optional[List[int]]:
+        """While a rank is blocked in this barrier, the sorted rank ids
+        that have NOT arrived (stall-watchdog attribution; a non-leader
+        stuck in depart() with every rank arrived gets the leader, which
+        owns the pending depart signal). None when not waiting. KV reads
+        only — safe from the watchdog thread."""
+        if not self._in_wait:
+            return None
+        missing = []
+        for r in range(self.world_size):
+            try:
+                if self.store.try_get(self._key("arrive", str(r))) is None:
+                    missing.append(r)
+            except Exception:
+                return None
+        if not missing:
+            return [self.leader_rank] if self.rank != self.leader_rank else None
+        return missing
 
     def arrive(self) -> None:
         from . import telemetry

@@ -75,6 +75,11 @@ class FaultPlan:
     - ``latency_sec``: seeded-jittered sleep on every op.
     - ``crash_after_op``: ("write", 7) → SIGKILL this process right
       after the 7th successful write (1-based).
+    - ``stall_op``: ("write", 3, 5.0) → the 3rd write ATTEMPT sleeps
+      5 s inside the op before proceeding normally (index 0 stalls
+      every attempt of the kind). The op stays in flight for the whole
+      sleep — the deterministic hang the stall watchdog
+      (:mod:`tpusnap.progress`) is tested against.
     """
 
     seed: int = 0
@@ -84,6 +89,7 @@ class FaultPlan:
     short_reads: bool = False
     latency_sec: float = 0.0
     crash_after_op: Optional[Tuple[str, int]] = None
+    stall_op: Optional[Tuple[str, int, float]] = None
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
@@ -109,6 +115,15 @@ class FaultPlan:
             elif key == "crash_after_op":
                 kind, _, idx = value.partition(":")
                 plan.crash_after_op = (kind, int(idx))
+            elif key == "stall_op":
+                # "write:3:5.0" → 3rd write attempt sleeps 5 s
+                # ("write:*:5.0" or index 0 → every attempt).
+                kind, idx, secs = value.split(":")
+                plan.stall_op = (
+                    kind,
+                    0 if idx == "*" else int(idx),
+                    float(secs),
+                )
             else:
                 raise ValueError(f"Unknown fault spec key {key!r} in {spec!r}")
         return plan
@@ -139,6 +154,7 @@ class _FaultState:
     rng: random.Random
     op_count: int = 0
     kind_success: Dict[str, int] = field(default_factory=dict)
+    kind_attempts: Dict[str, int] = field(default_factory=dict)
     per_op_attempts: Dict[Tuple[str, str], int] = field(default_factory=dict)
     lock: threading.Lock = field(default_factory=threading.Lock)
 
@@ -242,12 +258,33 @@ class FaultInjectionStoragePlugin(StoragePlugin):
         with self._state.lock:
             return self._state.rng.randrange(0, max(total, 1))
 
+    def _stall_seconds(self, kind: str) -> float:
+        """Injected in-op sleep for this attempt of ``kind`` (the
+        ``stall_op`` plan): 1-based attempt index, 0/``*`` = every."""
+        plan, st = self.plan, self._state
+        if plan.stall_op is None or plan.stall_op[0] != kind:
+            return 0.0
+        with st.lock:
+            n = st.kind_attempts.get(kind, 0) + 1
+            st.kind_attempts[kind] = n
+        idx = plan.stall_op[1]
+        return plan.stall_op[2] if idx == 0 or n == idx else 0.0
+
     async def _pre(self, kind: str, path: str) -> bool:
-        """Apply latency; return whether this attempt must fail."""
+        """Apply latency + injected stalls; return whether this attempt
+        must fail."""
         inject, latency = self._decide(kind, path)
         if latency:
             telemetry.incr("faults.latency_injections")
             await asyncio.sleep(latency)
+        stall = self._stall_seconds(kind)
+        if stall:
+            # The op is already in flight (the scheduler's op token is
+            # held across this await), so the sleep is exactly the
+            # no-forward-progress hang the watchdog must detect.
+            telemetry.incr(f"faults.stalled.{kind}")
+            telemetry.event("stall_injected", kind=kind, path=path, seconds=stall)
+            await asyncio.sleep(stall)
         if inject:
             # Always-on counter + instant trace event: a chaos take's
             # persisted trace shows exactly which ops drew faults.

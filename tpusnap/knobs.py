@@ -31,6 +31,9 @@ _RECORD_DEDUP_HASHES_ENV_VAR = "TPUSNAP_RECORD_DEDUP_HASHES"
 _DURABLE_COMMIT_ENV_VAR = "TPUSNAP_DURABLE_COMMIT"
 _TELEMETRY_ENV_VAR = "TPUSNAP_TELEMETRY"
 _DISABLE_JOURNAL_ENV_VAR = "TPUSNAP_DISABLE_JOURNAL"
+_STALL_DEADLINE_ENV_VAR = "TPUSNAP_STALL_DEADLINE_S"
+_HEARTBEAT_INTERVAL_ENV_VAR = "TPUSNAP_HEARTBEAT_INTERVAL_S"
+_TELEMETRY_DIR_ENV_VAR = "TPUSNAP_TELEMETRY_DIR"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -42,6 +45,17 @@ _DEFAULT_DIRECT_IO_CHUNK_BYTES = 32 * 1024 * 1024
 # Row-tile granularity for tile-grain checksums on large dense blobs
 # (the verifiable unit of memory-budgeted partial reads).
 _DEFAULT_TILE_CHECKSUM_BYTES = 16 * 1024 * 1024
+
+
+def _get_float_env(name: str, default: float) -> float:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        logger.warning("Ignoring non-numeric %s=%r", name, val)
+        return default
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -177,6 +191,39 @@ def is_telemetry_enabled() -> bool:
     return os.environ.get(_TELEMETRY_ENV_VAR, "1") != "0"
 
 
+def get_stall_deadline_s() -> float:
+    """No-forward-progress window after which a take's stall watchdog
+    (:mod:`tpusnap.progress`) emits its structured WARNING naming the
+    blocked op and — when attribution is available — the ranks that have
+    not arrived at the barrier it is stuck in. Well under the 600 s
+    barrier timeout by design: the point is an actionable log in
+    seconds, not another timeout."""
+    return max(0.1, _get_float_env(_STALL_DEADLINE_ENV_VAR, 30.0))
+
+
+def get_heartbeat_interval_s() -> float:
+    """Cadence of the per-rank heartbeat pump: progress records are
+    published at most once per interval (and only when something
+    changed, with a periodic keep-alive) — O(world) KV keys per
+    interval, never per op."""
+    return max(0.02, _get_float_env(_HEARTBEAT_INTERVAL_ENV_VAR, 0.5))
+
+
+def get_telemetry_dir() -> str:
+    """Local directory for telemetry that cannot live inside the
+    snapshot — restore traces (the snapshot is immutable once
+    committed). Defaults to a stable per-user tmp path (uid-suffixed:
+    a shared-host /tmp dir owned by the first user would EACCES every
+    other user's trace writes); override with
+    ``TPUSNAP_TELEMETRY_DIR``."""
+    import tempfile
+
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    return os.environ.get(_TELEMETRY_DIR_ENV_VAR) or os.path.join(
+        tempfile.gettempdir(), f"tpusnap-telemetry-{uid}"
+    )
+
+
 def get_memory_budget_override_bytes() -> Optional[int]:
     if _MEMORY_BUDGET_ENV_VAR not in os.environ:
         return None
@@ -283,4 +330,22 @@ def override_telemetry_enabled(enabled: bool) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_journal_disabled(disabled: bool) -> Generator[None, None, None]:
     with _override_env(_DISABLE_JOURNAL_ENV_VAR, "1" if disabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_stall_deadline_s(seconds: float) -> Generator[None, None, None]:
+    with _override_env(_STALL_DEADLINE_ENV_VAR, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def override_heartbeat_interval_s(seconds: float) -> Generator[None, None, None]:
+    with _override_env(_HEARTBEAT_INTERVAL_ENV_VAR, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def override_telemetry_dir(path: str) -> Generator[None, None, None]:
+    with _override_env(_TELEMETRY_DIR_ENV_VAR, path):
         yield

@@ -62,6 +62,9 @@ __all__ = [
 JOURNAL_FNAME = ".tpusnap/journal"
 JOURNAL_RECORDS_DIR = ".tpusnap/journal.d"
 _SIDECAR_PREFIX = ".tpusnap/"
+# Heartbeat records (tpusnap.progress): observability-only — ignored by
+# fsck's empty/foreign decision, legit in committed snapshots.
+_PROGRESS_SIDECAR_PREFIX = ".tpusnap/progress/"
 
 
 def journal_rank_path(rank: int) -> str:
@@ -499,12 +502,13 @@ def _referenced_locations(metadata: SnapshotMetadata) -> set:
 
 def _is_legit_sidecar(path: str) -> bool:
     """Sidecars a committed snapshot legitimately carries: telemetry
-    traces, nothing else. The journal family is NOT legit post-commit
-    (the commit clears it), and ``.tmp.<pid>`` debris anywhere —
-    including a SIGKILLed journal/telemetry atomic write — is
-    reclaimable, so both count as orphans."""
+    traces and the final heartbeat records, nothing else. The journal
+    family is NOT legit post-commit (the commit clears it), and
+    ``.tmp.<pid>`` debris anywhere — including a SIGKILLed
+    journal/telemetry/heartbeat atomic write — is reclaimable, so both
+    count as orphans."""
     return (
-        path.startswith(".tpusnap/telemetry/")
+        path.startswith((".tpusnap/telemetry/", ".tpusnap/progress/"))
         and ".tmp." not in path.rsplit("/", 1)[-1]
     )
 
@@ -643,12 +647,22 @@ def _fsck_impl(
             )
         return report
 
-    if files:
+    # Heartbeat records (.tpusnap/progress/) are observability
+    # breadcrumbs, never take evidence or payload: an ABORTED take
+    # cleans its blobs and journal but leaves its final "aborted"
+    # record for post-mortems — the path must still read as empty
+    # (reusable), not foreign.
+    meaningful = {
+        p: sz
+        for p, sz in files.items()
+        if not p.startswith(_PROGRESS_SIDECAR_PREFIX)
+    }
+    if meaningful:
         report.state = "foreign"
         report.detail = (
-            f"{len(files)} file(s) but no metadata and no journal — not "
-            "a tpusnap take (or a pre-journal crash); refusing to classify "
-            "as torn"
+            f"{len(meaningful)} file(s) but no metadata and no journal — "
+            "not a tpusnap take (or a pre-journal crash); refusing to "
+            "classify as torn"
         )
     else:
         report.state = "empty"

@@ -281,7 +281,12 @@ class _WritePipeline:
         from .io_types import SKIP_WRITE
 
         start = self.tele.now() if self.tele is not None else 0.0
-        buf = await self.write_req.buffer_stager.stage_buffer(executor)
+        token = self.tele.op_enter("stage_buffer") if self.tele is not None else None
+        try:
+            buf = await self.write_req.buffer_stager.stage_buffer(executor)
+        finally:
+            if self.tele is not None:
+                self.tele.op_exit(token)
         if self.tele is not None:
             self.tele.record_span(
                 "stage_buffer",
@@ -327,7 +332,16 @@ class _WritePipeline:
                         bytes=self.buf_size,
                     )
         write_start = self.tele.now() if self.tele is not None else 0.0
-        await self.storage.write(WriteIO(path=self.write_req.path, buf=self.buf))
+        token = (
+            self.tele.op_enter("storage_write") if self.tele is not None else None
+        )
+        try:
+            await self.storage.write(
+                WriteIO(path=self.write_req.path, buf=self.buf)
+            )
+        finally:
+            if self.tele is not None:
+                self.tele.op_exit(token)
         if self.tele is not None:
             self.tele.record_span(
                 "storage_write",
@@ -498,6 +512,11 @@ async def execute_write_reqs(
                     # Staged buffer may be smaller than the staging cost
                     # (e.g. cost model overestimates); credit the difference.
                     budget += pipeline.staging_cost - pipeline.buf_size
+                    # Heartbeat feed: bytes past the staging stage (the
+                    # window async_take blocks training on).
+                    telemetry.incr(
+                        "scheduler.bytes_staged", pipeline.buf_size, rec=tele
+                    )
                     if pipeline.skipped:
                         # Dedup'd against a previous snapshot: no I/O.
                         reporter.report_request_done(0)
@@ -563,9 +582,15 @@ def sync_execute_write_reqs(
 
 
 class _ReadPipeline:
-    def __init__(self, read_req: ReadReq, storage: StoragePlugin) -> None:
+    def __init__(
+        self,
+        read_req: ReadReq,
+        storage: StoragePlugin,
+        tele: Optional[telemetry.TakeTelemetry] = None,
+    ) -> None:
         self.read_req = read_req
         self.storage = storage
+        self.tele = tele
         # In-place reads allocate no full-size scratch buffer (bytes land
         # in the caller-owned restore target), so they are charged only
         # the plugin's transient overhead — the fs engine's per-stream
@@ -579,6 +604,17 @@ class _ReadPipeline:
         self.consuming_cost = cost
         self.read_io: Optional[ReadIO] = None
 
+    def _read_nbytes(self) -> int:
+        br = self.read_req.byte_range
+        if br is not None:
+            return int(br[1] - br[0])
+        if self.read_io is not None and self.read_io.buf is not None:
+            try:
+                return self.read_io.buf.getbuffer().nbytes
+            except Exception:
+                pass
+        return self.consuming_cost
+
     async def read(self) -> "_ReadPipeline":
         self.read_io = ReadIO(
             path=self.read_req.path,
@@ -586,11 +622,48 @@ class _ReadPipeline:
             into=self.read_req.into,
             want_crc=self.read_req.want_crc,
         )
-        await self.storage.read(self.read_io)
+        start = self.tele.now() if self.tele is not None else 0.0
+        token = (
+            self.tele.op_enter("storage_read") if self.tele is not None else None
+        )
+        try:
+            await self.storage.read(self.read_io)
+        finally:
+            if self.tele is not None:
+                self.tele.op_exit(token)
+        nbytes = self._read_nbytes()
+        if self.tele is not None:
+            self.tele.record_span(
+                "storage_read",
+                start,
+                self.tele.now() - start,
+                path=self.read_req.path,
+                bytes=nbytes,
+            )
+        telemetry.incr("storage.bytes_read", nbytes, rec=self.tele)
+        telemetry.incr("storage.reads", rec=self.tele)
         return self
 
     async def consume(self, executor: ThreadPoolExecutor) -> "_ReadPipeline":
-        await self.read_req.buffer_consumer.consume_read_io(self.read_io, executor)
+        # "consume" covers deserialize + the copy/`device_put` into the
+        # restore target (the HtoD leg for jax targets).
+        start = self.tele.now() if self.tele is not None else 0.0
+        token = self.tele.op_enter("consume") if self.tele is not None else None
+        try:
+            await self.read_req.buffer_consumer.consume_read_io(
+                self.read_io, executor
+            )
+        finally:
+            if self.tele is not None:
+                self.tele.op_exit(token)
+        if self.tele is not None:
+            self.tele.record_span(
+                "consume",
+                start,
+                self.tele.now() - start,
+                path=self.read_req.path,
+                bytes=self.consuming_cost,
+            )
         self.read_io = None  # release
         return self
 
@@ -605,9 +678,13 @@ async def execute_read_reqs(
         max_workers=_MAX_CPU_CONCURRENCY, thread_name_prefix="tpusnap-consume"
     )
     reporter = _Reporter(rank=rank, verb="read", total_reqs=len(read_reqs))
+    # Ambient recorder (the restore path installs one thread-locally);
+    # None for uninstrumented callers (verify's own engine, read_object
+    # outside a recorder) — spans then skip, counters stay global.
+    tele = telemetry.current()
     pipelines = deque(
         sorted(
-            (_ReadPipeline(rr, storage) for rr in read_reqs),
+            (_ReadPipeline(rr, storage, tele) for rr in read_reqs),
             key=lambda p: p.consuming_cost,
             reverse=True,
         )

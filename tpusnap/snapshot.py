@@ -285,6 +285,9 @@ class Snapshot:
                 abort_ctx.mark_commit_started()
                 _write_metadata(storage, metadata, event_loop)
             comm.barrier()
+            # Commit is definitive: publish the final heartbeat (100%)
+            # and stop the pump before the handle is returned.
+            tele_commit.finish_progress()
             if comm.rank == 0:
                 # Metadata committed and every rank departed: the take
                 # journal's job is done. Best-effort — a crash before
@@ -308,6 +311,10 @@ class Snapshot:
             abort_ctx.on_failure(e)
             raise
         finally:
+            # Safety net: on any exit path the pump thread must be gone
+            # (on_failure/finish_progress already stopped it; idempotent).
+            if abort_ctx.progress is not None:
+                abort_ctx.progress.stop()
             telemetry.end_take(tele)
             abort_ctx.disarm()
             event_loop.close()
@@ -440,6 +447,38 @@ class Snapshot:
     def _restore_locked(
         self, app_state, comm, per_key_barrier, memory_budget=None
     ) -> None:
+        # Restore telemetry: a dedicated recorder (thread-local overlay,
+        # so an in-flight take's global recorder is never disturbed)
+        # with contiguous phases (restore.plan → per-key targets/
+        # prepare/read/load) and the scheduler's storage_read/consume op
+        # spans. The snapshot is immutable, so the trace persists to
+        # the LOCAL trace dir (TPUSNAP_TELEMETRY_DIR) — rendered by
+        # `python -m tpusnap trace --restore <path>`.
+        tele = telemetry.TakeTelemetry(comm.rank)
+        mark = telemetry.PhaseMarker(rec=tele, from_start=True)
+        try:
+            with telemetry.use(tele):
+                self._restore_instrumented(
+                    app_state, comm, per_key_barrier, memory_budget, mark
+                )
+        finally:
+            tele.finalize()
+            summary = tele.summary()
+            telemetry.LAST_RESTORE_SUMMARY = summary
+            if tele.enabled:
+                try:
+                    from .progress import persist_restore_trace
+
+                    persist_restore_trace(tele, self.path)
+                except Exception:
+                    logger.warning(
+                        "Failed to persist restore trace (non-fatal)",
+                        exc_info=True,
+                    )
+
+    def _restore_instrumented(
+        self, app_state, comm, per_key_barrier, memory_budget, mark
+    ) -> None:
         event_loop, storage = self._resources()
         metadata = self._get_metadata(storage, event_loop)
         if memory_budget is None:
@@ -450,6 +489,8 @@ class Snapshot:
             keys = _gather_keys(comm, sorted(app_state.keys()))
         else:
             keys = sorted(app_state.keys())
+        # Metadata read/decode + budget + (optional) key gather.
+        mark("restore.plan")
         # RNG state is restored last so that loading other statefuls
         # cannot perturb it (reference snapshot.py:473-481).
         rng_keys = [
@@ -469,6 +510,7 @@ class Snapshot:
                 storage=storage,
                 memory_budget=memory_budget,
                 event_loop=event_loop,
+                mark=mark,
             )
 
     # ----------------------------------------------------------- random access
@@ -622,6 +664,9 @@ class _TakeAbortContext:
         self.write_paths: List[str] = []
         self.late_checksums: Optional["_LateChecksums"] = None
         self.tele_commit: Optional["_TelemetryCommit"] = None
+        # Heartbeat/watchdog monitor (tpusnap.progress) — stopped with
+        # a final "aborted" record on any failure path.
+        self.progress = None
         self.commit_started = False
         # Set once the take's journal exists: an ABORTED take (as opposed
         # to a SIGKILLed one) cleans its blobs, so it also clears the
@@ -643,6 +688,11 @@ class _TakeAbortContext:
 
     def on_failure(self, exc: BaseException) -> None:
         """Publish + clean up; never raises."""
+        if self.progress is not None:
+            try:
+                self.progress.finish("aborted")
+            except Exception:
+                pass
         if self.monitor is not None and not isinstance(exc, TakeAbortedError):
             self.monitor.publish(exc)
         keep_blobs = self.commit_started or (
@@ -976,6 +1026,24 @@ def _take_impl(
         if journal_enabled:
             abort_ctx.journal_world_size = journal_clear_ws
 
+    # Live observability (tpusnap.progress): heartbeat pump + stall
+    # watchdog for the rest of this take. Telemetry-off takes skip the
+    # subsystem entirely; everything it does is best-effort.
+    progress_monitor = None
+    if mark.rec is not None and mark.rec.enabled:
+        try:
+            from .progress import start_take_monitor
+
+            progress_monitor = start_take_monitor(
+                mark.rec, comm, take_id, path
+            )
+            if abort_ctx is not None:
+                abort_ctx.progress = progress_monitor
+        except Exception:
+            logger.warning(
+                "Failed to start progress monitor (non-fatal)", exc_info=True
+            )
+
     # Incremental snapshot: this rank's view of the base snapshot's
     # manifest, blob locations rewritten relative to the NEW root.
     prev_entries: Manifest = {}
@@ -1052,6 +1120,15 @@ def _take_impl(
         # (dedup-skipped paths are never written; deleting them is a
         # harmless no-op failure).
         abort_ctx.write_paths = [wr.path for wr in write_reqs]
+    if progress_monitor is not None:
+        # Denominator of the heartbeat's byte progress; dedup/salvage
+        # skips make written < planned, so the committed record forces
+        # 100% (the mid-flight figure is best-effort by design).
+        progress_monitor.set_bytes_planned(
+            sum(
+                wr.buffer_stager.get_staging_cost_bytes() for wr in write_reqs
+            )
+        )
 
     # Non-incremental takes hash on the WRITE path instead of the
     # staging window (see ArrayBufferStager.defer_checksums) — the hash
@@ -1124,7 +1201,9 @@ def _take_impl(
         or None,
     )
     mark("metadata")
-    tele_commit = _TelemetryCommit(mark.rec, comm, take_id)
+    tele_commit = _TelemetryCommit(
+        mark.rec, comm, take_id, progress=progress_monitor
+    )
     if abort_ctx is not None:
         abort_ctx.tele_commit = tele_commit
     return pending_io_work, metadata, path, storage, late_checksums, tele_commit
@@ -1470,11 +1549,29 @@ class _TelemetryCommit:
         tele: Optional[telemetry.TakeTelemetry],
         comm: Communicator,
         take_id: Optional[str],
+        progress=None,
     ) -> None:
         self.tele = tele
         self.comm = comm
         self.take_id = take_id
+        self.progress = progress
         self._summary: Optional[Dict[str, Any]] = None
+
+    def finish_progress(self, state: str = "committed") -> None:
+        """Publish the final heartbeat (100% at commit) and stop the
+        pump; idempotent and best-effort like everything here."""
+        if self.progress is not None:
+            try:
+                self.progress.finish(state)
+            except Exception:
+                pass
+
+    def stop_progress(self) -> None:
+        if self.progress is not None:
+            try:
+                self.progress.stop()
+            except Exception:
+                pass
 
     def _prefix(self) -> str:
         return f"tpusnap_tele/{self.take_id}/"
@@ -1653,11 +1750,13 @@ def _read_and_inflate(
     memory_budget: int,
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
+    mark: Optional[telemetry.PhaseMarker] = None,
 ) -> Any:
     """The one read pipeline for a key's manifest subtree: prepare reads
     (against targets when given), batch, execute under the budget,
-    inflate. Shared by ``restore`` (targets from the current state_dict)
-    and ``load_snapshot`` (no targets)."""
+    inflate. Shared by ``restore`` (targets from the current state_dict,
+    which also threads its phase marker) and ``load_snapshot`` (no
+    targets, no marker)."""
     from .batcher import batch_read_requests
 
     read_reqs = []
@@ -1673,7 +1772,12 @@ def _read_and_inflate(
         read_reqs.extend(reqs)
         futures[logical_path] = fut
     read_reqs = batch_read_requests(read_reqs)
+    if mark is not None:
+        mark("restore.prepare", reqs=len(read_reqs))
     sync_execute_read_reqs(read_reqs, storage, memory_budget, rank, event_loop)
+    if mark is not None:
+        # Storage reads + consume (deserialize/HtoD) under the budget.
+        mark("restore.read", reqs=len(read_reqs))
     flattened = {p: fut.obj for p, fut in futures.items()}
     container_manifest = {
         p: e for p, e in key_manifest.items() if is_container_entry(e)
@@ -1689,6 +1793,7 @@ def _load_stateful(
     storage: StoragePlugin,
     memory_budget: int,
     event_loop: asyncio.AbstractEventLoop,
+    mark: Optional[telemetry.PhaseMarker] = None,
 ) -> None:
     local_manifest = get_manifest_for_rank(metadata, rank)
     local_manifest = {
@@ -1704,6 +1809,8 @@ def _load_stateful(
     # shardings, in-place numpy buffers).
     target_manifest, target_flattened = flatten(stateful.state_dict(), prefix=key)
     handle_sharded_elasticity(local_manifest, target_flattened)
+    if mark is not None:
+        mark("restore.targets", key=key)
 
     restored = _read_and_inflate(
         key,
@@ -1713,8 +1820,11 @@ def _load_stateful(
         memory_budget,
         rank,
         event_loop,
+        mark=mark,
     )
     stateful.load_state_dict(restored)
+    if mark is not None:
+        mark("restore.load", key=key)
 
 
 # ------------------------------------------------------------- async commit
@@ -1833,6 +1943,11 @@ class PendingSnapshot(_BackgroundWork):
         # watcher above.
         if abort_ctx is not None:
             abort_ctx.disarm()
+        # The background commit synchronizes through the LinearBarrier,
+        # not the communicator — point the stall watchdog's straggler
+        # attribution at its arrive keys.
+        if tele_commit is not None and tele_commit.progress is not None:
+            tele_commit.progress.add_attribution(self._barrier.current_missing)
         # Control is about to return to training: release the recorder's
         # process-global slot (a newer take may install its own); the
         # background drain records through captured references + the
@@ -1918,6 +2033,8 @@ class PendingSnapshot(_BackgroundWork):
             self._comm.gc_consumed_keys(self._gc_epoch)
         except Exception:
             pass
+        if self._tele_commit is not None:
+            self._tele_commit.finish_progress()
         snapshot = Snapshot(self.path, self._storage_options, self._comm)
         if self._comm.rank == 0:
             snapshot._metadata = self._metadata
@@ -1940,6 +2057,10 @@ class PendingSnapshot(_BackgroundWork):
         self._barrier.report_error(exc)
 
     def _cleanup(self) -> None:
+        if self._tele_commit is not None:
+            # Failure paths stopped it with an "aborted" record already
+            # (abort_ctx.on_failure); this is the idempotent safety net.
+            self._tele_commit.stop_progress()
         self._storage.sync_close(self._event_loop)
         self._event_loop.close()
         if self._tele_commit is not None and self._tele_commit.tele is not None:

@@ -57,6 +57,11 @@ TELEMETRY_DIR = ".tpusnap/telemetry"
 # JSON without re-reading the snapshot.
 LAST_TAKE_SUMMARY: Optional[Dict[str, Any]] = None
 
+# Summary of the most recent completed restore in this process (set by
+# Snapshot._restore_locked) — the restore-path counterpart benchmarks
+# read for their restore stage_breakdown.
+LAST_RESTORE_SUMMARY: Optional[Dict[str, Any]] = None
+
 
 def telemetry_rank_path(rank: int) -> str:
     """Storage-relative path of one rank's persisted trace."""
@@ -96,6 +101,19 @@ def unregister_metrics_sink(sink: MetricsSink) -> None:
     global _sinks
     with _sinks_lock:
         _sinks = tuple(s for s in _sinks if s is not sink)
+
+
+@contextmanager
+def metrics_sink(sink: MetricsSink) -> Generator[MetricsSink, None, None]:
+    """Scoped registration: ``with metrics_sink(MySink()) as s: ...``
+    unregisters on exit even when the body raises — a failing test (or a
+    short-lived collector) can no longer leak its sink into the
+    process-global tuple."""
+    register_metrics_sink(sink)
+    try:
+        yield sink
+    finally:
+        unregister_metrics_sink(sink)
 
 
 def _notify(method: str, *args) -> None:
@@ -150,6 +168,12 @@ class TakeTelemetry:
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
         self._finalized_wall_s: Optional[float] = None
+        # Live state for the heartbeat/watchdog (tpusnap.progress):
+        # in-flight named ops keyed by an opaque token (an op may span
+        # awaits, so a per-thread stack would mis-pop under the event
+        # loop's interleaving), plus the most recently COMPLETED phase.
+        self._inflight: Dict[object, Tuple[str, str]] = {}
+        self._last_phase: Optional[str] = None
         self._rss_sampler = None
         if self.enabled:
             try:
@@ -188,10 +212,64 @@ class TakeTelemetry:
             yield
             return
         start = self.now()
+        token = self.op_enter(name)
         try:
             yield
         finally:
+            self.op_exit(token)
             self.record_span(name, start, self.now() - start, phase=phase, **attrs)
+
+    # --- live state (heartbeat/watchdog feed) ---------------------------
+
+    def op_enter(self, name: str) -> Optional[object]:
+        """Mark a named op as in flight; returns the token to pass back
+        to :meth:`op_exit`. No-op (None) when span capture is off."""
+        if not self.enabled:
+            return None
+        token = object()
+        thread = threading.current_thread().name
+        with self._lock:
+            self._inflight[token] = (thread, name)
+        return token
+
+    def op_exit(self, token: Optional[object]) -> None:
+        if token is None:
+            return
+        with self._lock:
+            self._inflight.pop(token, None)
+
+    @contextmanager
+    def op(self, name: str) -> Generator[None, None, None]:
+        """In-flight tracking only (no span record) — for call sites
+        that record their span manually but should still be visible to
+        the stall watchdog while blocked."""
+        token = self.op_enter(name)
+        try:
+            yield
+        finally:
+            self.op_exit(token)
+
+    def note_phase(self, name: str) -> None:
+        """Record ``name`` as the most recently completed phase (called
+        by :class:`PhaseMarker`); read by the heartbeat publisher."""
+        self._last_phase = name
+
+    def live_snapshot(self) -> Dict[str, Any]:
+        """One consistent snapshot of the recorder's observable state
+        for the progress pump: last completed phase, in-flight ops in
+        start order (oldest first), counters, and a monotonically
+        growing mark count (spans + events) whose advance IS forward
+        progress."""
+        with self._lock:
+            ops = list(self._inflight.values())
+            counters = dict(self._counters)
+            marks = len(self._spans) + len(self._events)
+        return {
+            "phase": self._last_phase,
+            "ops": ops,
+            "counters": counters,
+            "marks": marks,
+        }
 
     def event(self, name: str, **attrs: Any) -> None:
         if not self.enabled:
@@ -456,6 +534,7 @@ class PhaseMarker:
             return
         now = self.rec.now()
         self.rec.record_span(name, self.last, now - self.last, phase=True, **attrs)
+        self.rec.note_phase(name)
         self.last = now
 
 def phase_marker(from_start: bool = False) -> PhaseMarker:
@@ -468,16 +547,26 @@ def phase_marker(from_start: bool = False) -> PhaseMarker:
 def rollup_summaries(summaries: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Cross-rank rollup rank 0 folds into the metadata extras: per
     stage, the p50/max over ranks of each rank's TOTAL time in that
-    stage; summed counters; max gauges; slowest-rank wall-clock."""
+    stage — WITH the straggler's rank id (``max_rank``); summed
+    counters; max gauges; slowest-rank wall-clock; and ``phase_skew``,
+    the per-phase straggler attribution (slowest rank + max/p50 skew)
+    the stall watchdog's post-mortem reads."""
     summaries = [s for s in summaries if s]
     if not summaries:
         return {}
-    stage_totals: Dict[str, List[float]] = {}
+    # (total_s, rank) pairs so the straggler keeps its rank id.
+    stage_totals: Dict[str, List[Tuple[float, int]]] = {}
+    phase_totals: Dict[str, List[Tuple[float, int]]] = {}
     counters: Dict[str, int] = {}
     gauges: Dict[str, float] = {}
-    for s in summaries:
+    for i, s in enumerate(summaries):
+        rank = s.get("rank", i)
         for name, agg in (s.get("stages") or {}).items():
-            stage_totals.setdefault(name, []).append(agg.get("total_s", 0.0))
+            stage_totals.setdefault(name, []).append(
+                (agg.get("total_s", 0.0), rank)
+            )
+        for name, v in (s.get("phases") or {}).items():
+            phase_totals.setdefault(name, []).append((v, rank))
         for name, v in (s.get("counters") or {}).items():
             counters[name] = counters.get(name, 0) + v
         for name, v in (s.get("gauges") or {}).items():
@@ -488,10 +577,22 @@ def rollup_summaries(summaries: List[Dict[str, Any]]) -> Dict[str, Any]:
         ts = sorted(totals)
         stages[name] = {
             "ranks": len(ts),
-            "p50_s": round(ts[len(ts) // 2], 6),
-            "max_s": round(ts[-1], 6),
+            "p50_s": round(ts[len(ts) // 2][0], 6),
+            "max_s": round(ts[-1][0], 6),
+            "max_rank": ts[-1][1],
+        }
+    phase_skew = {}
+    for name, totals in sorted(phase_totals.items()):
+        ts = sorted(totals)
+        p50, mx = ts[len(ts) // 2][0], ts[-1][0]
+        phase_skew[name] = {
+            "p50_s": round(p50, 6),
+            "max_s": round(mx, 6),
+            "max_rank": ts[-1][1],
+            "skew": round(mx / p50, 3) if p50 > 0 else None,
         }
     return {
+        "phase_skew": phase_skew,
         "ranks": len(summaries),
         "take_wall_s": round(max(s.get("take_wall_s", 0.0) for s in summaries), 6),
         "phase_coverage_min": round(
