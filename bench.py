@@ -711,114 +711,167 @@ def main() -> None:
     finally:
         shutil.rmtree(bench_root, ignore_errors=True)
 
-    print(
-        json.dumps(
+    # Warm-only views of the full-scale run arrays: run 0 is the COLD
+    # run of its section (first take at full scale faults/evicts the
+    # page-cache working set the later runs inherit — r05's 0.206
+    # first-run outlier in roofline_fraction_fullscale_runs), so trend
+    # tooling should read the warm aggregates and treat runs[cold_run_index]
+    # as warmup, not regression. The cross-run history applies the same
+    # rule via its cold tag.
+    def _warm(vals):
+        return vals[1:] if len(vals) > 1 else vals
+
+    result = {
+        "metric": "snapshot_take_local_fs",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+        "roofline_gbps": round(roofline, 3),
+        # Median of same-round take/roofline pairs from the
+        # tight ~2 GB probe (seconds per sample, so the pair
+        # genuinely shares a host/disk window; full-scale
+        # pairs span minutes and drift several-fold — their
+        # fractions are published below as a diagnostic).
+        "roofline_fraction": round(
+            statistics.median(take_probe_fracs), 3
+        ),
+        "roofline_fraction_probe_gb": round(
+            min(TOTAL_BYTES, 2 * 1024**3) / 1024**3, 2
+        ),
+        "roofline_fraction_runs": [
+            round(f, 3) for f in take_probe_fracs
+        ],
+        # Full-scale pairs for the same metric, published so
+        # the redefinition is auditable: at 20 GB each pair
+        # member spans minutes and host contention drifts
+        # inside the pair, which is WHY the headline fraction
+        # moved to the probe scale (r4->r5).
+        "roofline_fraction_fullscale": round(
+            statistics.median(take_fracs), 3
+        ),
+        "roofline_fraction_fullscale_runs": [
+            round(f, 3) for f in take_fracs
+        ],
+        # Index of the cold-cache run in every *_runs array of
+        # this JSON (the section's first run), plus warm-only
+        # aggregates so trend tooling doesn't flag warmup.
+        "cold_run_index": 0,
+        "roofline_fraction_fullscale_warm": round(
+            statistics.median(_warm(take_fracs)), 3
+        ),
+        "roofline_runs_gbps": [round(r, 3) for r in rooflines],
+        "take_runs_s": [round(t, 2) for t in times],
+        "take_warm_best_s": round(min(_warm(times)), 2),
+        "stage_breakdown": stage_breakdown,
+        "staging_s": round(staging_s, 2) if staging_s else None,
+        "residual_io_s": (
+            round(sched_total_s - staging_s, 2)
+            if staging_s and sched_total_s
+            else None
+        ),
+        "restore_gbps": round(restore_gbps, 3),
+        # Median of per-round like-for-like pairs from the
+        # tight-window probe: warm restore / prefaulted+CRC
+        # engine reads — neither side faults pages, both
+        # checksum every byte, both in one disk window.
+        "restore_verified_fraction": round(
+            statistics.median(restore_verified_fracs), 3
+        ),
+        "restore_verified_fraction_runs": [
+            round(f, 3) for f in restore_verified_fracs
+        ],
+        "restore_roofline_verified_runs_gbps": [
+            round(r, 3) for r in restore_rooflines_verified
+        ],
+        "restore_runs_s": [round(t, 2) for t in restore_runs],
+        "restore_stage_breakdown": restore_stage_breakdown,
+        "restore_warm_gbps": round(
+            nbytes / min(restore_warm_runs) / 1e9, 3
+        ),
+        "restore_warm_runs_s": [
+            round(t, 2) for t in restore_warm_runs
+        ],
+        "restore_warmup_s": round(restore_warmup_s, 2),
+        "restore_cold_cache": cold,
+        "restore_verified": ok,
+        # Warm = the steady-state checkpoint loop (pool pages
+        # reused); cold = first take of the process.
+        "async_take_blocked_s": round(async_blocked[-1], 2),
+        "async_take_blocked_cold_s": round(async_blocked[0], 2),
+        "async_take_total_s": round(async_total[-1], 2),
+        # Clone-path RSS: must be >> 0 (the defensive clones are
+        # real allocations) — doubles as the RSS sampler's
+        # self-check, unlike the sync take whose zero-copy
+        # staging pinned the old take_peak_rss_mb at 0.
+        "async_take_peak_rss_mb": round(async_peak_rss / 1e6),
+        "memory_budget_gb": (
+            round(budget_bytes / 1e9, 2) if budget_bytes else None
+        ),
+        "incremental_take_s": round(inc_take_s, 2),
+        "incremental_effective_gbps": round(
+            nbytes / inc_take_s / 1e9, 3
+        ),
+        "scrub_s": round(scrub_s, 2),
+        "scrub_gbps": round(scrub_bytes / scrub_s / 1e9, 3),
+        "scrub_roofline_gbps": round(scrub_roofline, 3),
+        # Median of same-round pairs from the tight probe.
+        "scrub_roofline_fraction": round(
+            statistics.median(scrub_probe_fracs), 3
+        ),
+        "scrub_roofline_fraction_runs": [
+            round(f, 3) for f in scrub_probe_fracs
+        ],
+        "scrub_roofline_fraction_fullscale_runs": [
+            round(f, 3) for f in scrub_fullscale_fracs
+        ],
+        "scrub_roofline_fraction_fullscale_warm": round(
+            statistics.median(_warm(scrub_fullscale_fracs)), 3
+        ),
+        "scrub_runs_gbps": [
+            round(scrub_bytes / t / 1e9, 3) for t in scrub_runs
+        ],
+        "scrub_roofline_runs_gbps": [
+            round(r, 3) for r in scrub_rooflines
+        ],
+        "scrub_clean": scrub_clean,
+        "pinned_host": pinned_host,
+    }
+
+    # Record the headline trajectory into the same cross-run history the
+    # takes/restores above already fed (kind="take"/"restore", first run
+    # cold-tagged automatically) — BENCH_r*.json trajectories become
+    # queryable by `python -m tpusnap history --kind bench [--check]`.
+    try:
+        from tpusnap import history as _hist
+
+        _hist.record_event(
             {
-                "metric": "snapshot_take_local_fs",
-                "value": round(gbps, 3),
-                "unit": "GB/s",
-                "vs_baseline": round(gbps / BASELINE_GBPS, 3),
-                "roofline_gbps": round(roofline, 3),
-                # Median of same-round take/roofline pairs from the
-                # tight ~2 GB probe (seconds per sample, so the pair
-                # genuinely shares a host/disk window; full-scale
-                # pairs span minutes and drift several-fold — their
-                # fractions are published below as a diagnostic).
-                "roofline_fraction": round(
-                    statistics.median(take_probe_fracs), 3
-                ),
-                "roofline_fraction_probe_gb": round(
-                    min(TOTAL_BYTES, 2 * 1024**3) / 1024**3, 2
-                ),
-                "roofline_fraction_runs": [
-                    round(f, 3) for f in take_probe_fracs
+                "v": 1,
+                "ts": round(time.time(), 3),
+                "kind": "bench",
+                "rank": 0,
+                "world_size": 1,
+                "bytes": nbytes,
+                "wall_s": round(best, 3),
+                "throughput_gbps": round(gbps, 3),
+                "roofline_fraction": result["roofline_fraction"],
+                "roofline_fraction_fullscale_warm": result[
+                    "roofline_fraction_fullscale_warm"
                 ],
-                # Full-scale pairs for the same metric, published so
-                # the redefinition is auditable: at 20 GB each pair
-                # member spans minutes and host contention drifts
-                # inside the pair, which is WHY the headline fraction
-                # moved to the probe scale (r4->r5).
-                "roofline_fraction_fullscale": round(
-                    statistics.median(take_fracs), 3
-                ),
-                "roofline_fraction_fullscale_runs": [
-                    round(f, 3) for f in take_fracs
+                "restore_gbps": result["restore_gbps"],
+                "restore_verified_fraction": result[
+                    "restore_verified_fraction"
                 ],
-                "roofline_runs_gbps": [round(r, 3) for r in rooflines],
-                "take_runs_s": [round(t, 2) for t in times],
-                "stage_breakdown": stage_breakdown,
-                "staging_s": round(staging_s, 2) if staging_s else None,
-                "residual_io_s": (
-                    round(sched_total_s - staging_s, 2)
-                    if staging_s and sched_total_s
-                    else None
-                ),
-                "restore_gbps": round(restore_gbps, 3),
-                # Median of per-round like-for-like pairs from the
-                # tight-window probe: warm restore / prefaulted+CRC
-                # engine reads — neither side faults pages, both
-                # checksum every byte, both in one disk window.
-                "restore_verified_fraction": round(
-                    statistics.median(restore_verified_fracs), 3
-                ),
-                "restore_verified_fraction_runs": [
-                    round(f, 3) for f in restore_verified_fracs
+                "scrub_gbps": result["scrub_gbps"],
+                "incremental_effective_gbps": result[
+                    "incremental_effective_gbps"
                 ],
-                "restore_roofline_verified_runs_gbps": [
-                    round(r, 3) for r in restore_rooflines_verified
-                ],
-                "restore_runs_s": [round(t, 2) for t in restore_runs],
-                "restore_stage_breakdown": restore_stage_breakdown,
-                "restore_warm_gbps": round(
-                    nbytes / min(restore_warm_runs) / 1e9, 3
-                ),
-                "restore_warm_runs_s": [
-                    round(t, 2) for t in restore_warm_runs
-                ],
-                "restore_warmup_s": round(restore_warmup_s, 2),
-                "restore_cold_cache": cold,
-                "restore_verified": ok,
-                # Warm = the steady-state checkpoint loop (pool pages
-                # reused); cold = first take of the process.
-                "async_take_blocked_s": round(async_blocked[-1], 2),
-                "async_take_blocked_cold_s": round(async_blocked[0], 2),
-                "async_take_total_s": round(async_total[-1], 2),
-                # Clone-path RSS: must be >> 0 (the defensive clones are
-                # real allocations) — doubles as the RSS sampler's
-                # self-check, unlike the sync take whose zero-copy
-                # staging pinned the old take_peak_rss_mb at 0.
-                "async_take_peak_rss_mb": round(async_peak_rss / 1e6),
-                "memory_budget_gb": (
-                    round(budget_bytes / 1e9, 2) if budget_bytes else None
-                ),
-                "incremental_take_s": round(inc_take_s, 2),
-                "incremental_effective_gbps": round(
-                    nbytes / inc_take_s / 1e9, 3
-                ),
-                "scrub_s": round(scrub_s, 2),
-                "scrub_gbps": round(scrub_bytes / scrub_s / 1e9, 3),
-                "scrub_roofline_gbps": round(scrub_roofline, 3),
-                # Median of same-round pairs from the tight probe.
-                "scrub_roofline_fraction": round(
-                    statistics.median(scrub_probe_fracs), 3
-                ),
-                "scrub_roofline_fraction_runs": [
-                    round(f, 3) for f in scrub_probe_fracs
-                ],
-                "scrub_roofline_fraction_fullscale_runs": [
-                    round(f, 3) for f in scrub_fullscale_fracs
-                ],
-                "scrub_runs_gbps": [
-                    round(scrub_bytes / t / 1e9, 3) for t in scrub_runs
-                ],
-                "scrub_roofline_runs_gbps": [
-                    round(r, 3) for r in scrub_rooflines
-                ],
-                "scrub_clean": scrub_clean,
-                "pinned_host": pinned_host,
             }
         )
-    )
+    except Exception:
+        pass
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -215,6 +215,40 @@ def test_raising_sink_never_breaks_a_take(tmp_path):
     assert snap.verify().clean
 
 
+def test_raising_sink_warns_once_per_callback_per_take(tmp_path, caplog):
+    """A broken exporter must be diagnosable, not invisible: one
+    rate-limited WARNING per sink class per callback per take, naming
+    both — and the budget re-arms on the next take."""
+
+    class BoomSink(MetricsSink):
+        def on_span(self, name, duration_s, attrs):
+            raise RuntimeError("boom")
+
+        def on_counter(self, name, delta, value):
+            raise RuntimeError("boom")
+
+    def warnings_for(records, method):
+        return [
+            r
+            for r in records
+            if r.levelname == "WARNING"
+            and "BoomSink" in r.message
+            and method in r.message
+        ]
+
+    with metrics_sink(BoomSink()):
+        with caplog.at_level(logging.WARNING, logger="tpusnap.telemetry"):
+            Snapshot.take(str(tmp_path / "s1"), {"m": PytreeState(_state())})
+        # Many spans and counters fired; exactly ONE warning per callback.
+        assert len(warnings_for(caplog.records, "on_span")) == 1
+        assert len(warnings_for(caplog.records, "on_counter")) == 1
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="tpusnap.telemetry"):
+            Snapshot.take(str(tmp_path / "s2"), {"m": PytreeState(_state())})
+        # Fresh take -> the one-warning budget re-arms.
+        assert len(warnings_for(caplog.records, "on_span")) == 1
+
+
 def test_metrics_sink_context_manager_unregisters_on_raise():
     """A failing test body can no longer leak its sink into the
     process-global tuple (the leak the context manager exists to fix)."""

@@ -46,12 +46,28 @@ try:  # pragma: no cover - import surface grows as modules land
         register_metrics_sink,
         unregister_metrics_sink,
     )
+    from .metrics_export import (  # noqa: F401
+        JsonlEventSink,
+        PrometheusTextfileSink,
+    )
+    from .history import (  # noqa: F401
+        RegressionReport,
+        check_regression,
+        load_history,
+        record_event,
+    )
 
     __all__ += [
         "MetricsSink",
         "metrics_sink",
         "register_metrics_sink",
         "unregister_metrics_sink",
+        "JsonlEventSink",
+        "PrometheusTextfileSink",
+        "RegressionReport",
+        "check_regression",
+        "load_history",
+        "record_event",
         "ScrubReport",
         "verify_snapshot",
         "FsckReport",

@@ -44,11 +44,21 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
                         stragglers flagged), refreshing in place until
                         the take commits (``--once``/``--json`` for one
                         frame; exit 3 = no heartbeat records found)
+  history               cross-run take/restore performance history from
+                        this host's TPUSNAP_TELEMETRY_DIR/history.jsonl
+                        (one event per completed take/restore; bench.py
+                        records its runs too): trend table or ``--json``;
+                        ``--check`` compares the latest run against the
+                        trailing median (``--window``/``--threshold``,
+                        cold-run-aware) and exits 2 on a regression so
+                        CI and cron jobs can gate on it (exit 3 = not
+                        enough comparable history / no events)
 
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
-(or provably-different diff), 3 undecidable/unverifiable (or no
-telemetry recorded; fsck: empty/foreign), 4 torn take (fsck —
-salvageable by retaking the path).
+(or provably-different diff; history --check: regression), 3
+undecidable/unverifiable (or no telemetry recorded; fsck: empty/foreign;
+history: no/insufficient events), 4 torn take (fsck — salvageable by
+retaking the path).
 """
 
 from __future__ import annotations
@@ -133,6 +143,47 @@ def cmd_info(args) -> int:
             f"snapshot(s): {', '.join(bases)} — keep them alive (or "
             f"`materialize` to make this snapshot self-contained)"
         )
+    # Telemetry rollup highlights (metadata.extras — no trace reads):
+    # the take's headline numbers without a separate `trace` invocation.
+    t = (md.extras or {}).get("telemetry")
+    if t:
+        wall = t.get("take_wall_s")
+        bw = t.get("bytes_written") or 0
+        if wall:
+            line = f"take:        {_fmt_seconds(wall)}"
+            if bw:
+                line += f", {_fmt_bytes(bw)} written"
+                if wall > 0:
+                    line += f" ({bw / wall / 1e9:.2f} GB/s)"
+            print(line)
+        counters = t.get("counters") or {}
+        notable = {
+            "retries": t.get("retry_attempts") or 0,
+            "stall episodes": counters.get("progress.stall_episodes", 0),
+            "blobs salvaged": counters.get("salvage.blobs_salvaged", 0),
+            "dedup skips": counters.get("scheduler.dedup_skipped", 0),
+        }
+        notes = [f"{v} {k}" for k, v in notable.items() if v]
+        if notes:
+            print(f"             {', '.join(notes)}")
+        skew = t.get("phase_skew") or {}
+        if (t.get("ranks") or 1) > 1 and skew:
+            worst_name, worst = max(
+                (
+                    (name, agg)
+                    for name, agg in skew.items()
+                    if agg.get("skew")
+                ),
+                key=lambda kv: kv[1]["skew"],
+                default=(None, None),
+            )
+            if worst is not None and worst["skew"] > 1.0:
+                print(
+                    f"skew:        {worst_name} rank {worst.get('max_rank')} "
+                    f"at {_fmt_seconds(worst.get('max_s'))} "
+                    f"({worst['skew']:.2f}x the p50) — "
+                    "`trace` for the full breakdown"
+                )
     return 0
 
 
@@ -516,6 +567,99 @@ def cmd_watch(args) -> int:
         time.sleep(args.interval)
 
 
+def cmd_history(args) -> int:
+    import datetime
+    import json as _json
+
+    from .history import check_regression, history_path, load_history
+
+    path = args.file or history_path()
+    events = load_history(path)
+    if args.check:
+        if args.kind == "all":
+            # Checking pools of incommensurable metrics is meaningless;
+            # refuse instead of silently coercing to one kind.
+            print(
+                "error: --check needs one event kind "
+                "(--kind take|restore|bench); run one check per kind",
+                file=sys.stderr,
+            )
+            return 1
+        report = check_regression(
+            events,
+            kind=args.kind,
+            metric=args.metric,
+            window=args.window,
+            threshold=args.threshold,
+            min_baseline=args.min_baseline,
+        )
+        if args.json:
+            print(_json.dumps({"file": path, **report.to_json()}))
+        else:
+            verdict = (
+                "REGRESSION"
+                if report.regressed
+                else ("OK" if report.ok else "INSUFFICIENT DATA")
+            )
+            print(f"{verdict} [{report.kind}]: {report.reason}")
+            if report.baseline_median is not None:
+                print(
+                    f"  latest {report.latest:.4g} vs trailing-median "
+                    f"{report.baseline_median:.4g} over {report.n_baseline} "
+                    f"run(s) (threshold {report.threshold:.0%})"
+                )
+        if report.regressed:
+            return 2
+        return 0 if report.ok else 3
+    shown = [
+        e for e in events if args.kind == "all" or e.get("kind") == args.kind
+    ]
+    if args.limit:
+        shown = shown[-args.limit :]
+    if args.json:
+        print(_json.dumps({"file": path, "events": shown}))
+        return 0 if shown else 3
+    if not shown:
+        print(
+            f"no history recorded (kind {args.kind!r}; looked in {path})",
+            file=sys.stderr,
+        )
+        return 3
+    print(
+        f"{'when':<16} {'kind':<8} {'rank':>4} {'world':>5} "
+        f"{'GB':>8} {'wall':>9} {'GB/s':>7}  notes"
+    )
+    for e in shown:
+        ts = e.get("ts")
+        when = (
+            datetime.datetime.fromtimestamp(ts).strftime("%m-%d %H:%M:%S")
+            if ts
+            else "-"
+        )
+        gbps = e.get("throughput_gbps")
+        notes = []
+        if e.get("cold"):
+            notes.append("cold")
+        if e.get("stall_episodes"):
+            notes.append(f"{e['stall_episodes']} stall(s)")
+        if e.get("retry_attempts"):
+            notes.append(f"{e['retry_attempts']} retries")
+        if e.get("blobs_salvaged"):
+            notes.append(f"{e['blobs_salvaged']} salvaged")
+        if e.get("dedup_skips"):
+            notes.append(f"{e['dedup_skips']} dedup")
+        print(
+            f"{when:<16} {e.get('kind', '?'):<8} {e.get('rank', 0):>4} "
+            f"{e.get('world_size', 1):>5} "
+            f"{(e.get('bytes') or 0) / 1e9:>8.2f} "
+            f"{_fmt_seconds(e.get('wall_s')):>9} "
+            f"{(f'{gbps:.2f}' if gbps is not None else '-'):>7}  "
+            f"{' '.join(notes)}"
+        )
+    print(f"({len(shown)} of {len(events)} event(s) in {path})")
+    return 0
+
+
 def cmd_cat(args) -> int:
     out = Snapshot(args.path).read_object(args.manifest_path)
     if isinstance(out, np.ndarray):
@@ -617,6 +761,54 @@ def main(argv=None) -> int:
         "(default 10)",
     )
     p.set_defaults(fn=cmd_watch)
+
+    p = sub.add_parser(
+        "history",
+        help="cross-run take/restore performance history "
+        "(--check = regression gate for CI/cron)",
+    )
+    p.add_argument(
+        "--file", default=None,
+        help="history file (default: TPUSNAP_TELEMETRY_DIR/history.jsonl)",
+    )
+    p.add_argument(
+        "--kind", default="take",
+        choices=["take", "restore", "bench", "all"],
+        help="event kind to show/check (default take)",
+    )
+    p.add_argument(
+        "-n", "--limit", type=int, default=20, metavar="N",
+        help="show the newest N events (default 20; 0 = all)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="compare the latest run against the trailing median; "
+        "exit 2 on regression, 3 on insufficient comparable history",
+    )
+    p.add_argument(
+        "--metric", default="throughput_gbps",
+        help="event field to check (default throughput_gbps; *_s metrics "
+        "regress upward)",
+    )
+    p.add_argument(
+        "--window", type=int, default=20, metavar="N",
+        help="trailing baseline window (default 20 runs)",
+    )
+    p.add_argument(
+        "--threshold", type=float, default=0.25, metavar="F",
+        help="regression threshold as a fraction of the trailing median "
+        "(default 0.25)",
+    )
+    p.add_argument(
+        "--min-baseline", type=int, default=3, metavar="N",
+        dest="min_baseline",
+        help="minimum comparable baseline runs to form a verdict "
+        "(default 3)",
+    )
+    p.set_defaults(fn=cmd_history)
 
     p = sub.add_parser(
         "fsck",

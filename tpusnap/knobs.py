@@ -34,6 +34,10 @@ _DISABLE_JOURNAL_ENV_VAR = "TPUSNAP_DISABLE_JOURNAL"
 _STALL_DEADLINE_ENV_VAR = "TPUSNAP_STALL_DEADLINE_S"
 _HEARTBEAT_INTERVAL_ENV_VAR = "TPUSNAP_HEARTBEAT_INTERVAL_S"
 _TELEMETRY_DIR_ENV_VAR = "TPUSNAP_TELEMETRY_DIR"
+_METRICS_EXPORT_ENV_VAR = "TPUSNAP_METRICS_EXPORT"
+_METRICS_DIR_ENV_VAR = "TPUSNAP_METRICS_DIR"
+_HISTORY_ENV_VAR = "TPUSNAP_HISTORY"
+_HISTORY_MAX_BYTES_ENV_VAR = "TPUSNAP_HISTORY_MAX_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -224,6 +228,71 @@ def get_telemetry_dir() -> str:
     )
 
 
+_KNOWN_METRICS_FORMATS = ("prom", "jsonl")
+# Unknown-format tokens already warned about: get_metrics_export runs at
+# every take/restore begin, and one typo must not spam a WARNING per
+# checkpoint for the job's whole life.
+_warned_metrics_formats: set = set()
+
+
+def get_metrics_export() -> tuple:
+    """Fleet metrics export formats (:mod:`tpusnap.metrics_export`),
+    comma-separated: ``prom`` (Prometheus textfile, atomic ``.prom``
+    rewrite per take/restore summary for node-exporter textfile
+    collection) and/or ``jsonl`` (structured per-summary event lines,
+    rotation-bounded). Empty (the default) exports nothing; unknown
+    names warn once per process and are skipped rather than failing a
+    take."""
+    raw = os.environ.get(_METRICS_EXPORT_ENV_VAR, "")
+    out = []
+    for tok in raw.replace(";", ",").split(","):
+        tok = tok.strip().lower()
+        if not tok:
+            continue
+        if tok not in _KNOWN_METRICS_FORMATS:
+            if tok not in _warned_metrics_formats:
+                _warned_metrics_formats.add(tok)
+                logger.warning(
+                    "Ignoring unknown %s format %r (known: %s)",
+                    _METRICS_EXPORT_ENV_VAR,
+                    tok,
+                    ", ".join(_KNOWN_METRICS_FORMATS),
+                )
+            continue
+        if tok not in out:
+            out.append(tok)
+    return tuple(out)
+
+
+def get_metrics_dir() -> str:
+    """Directory the export sinks write into (``.prom`` textfiles, the
+    JSONL event log). Defaults to the telemetry dir so a node's whole
+    observability surface lives under one path; point
+    ``TPUSNAP_METRICS_DIR`` at the node-exporter textfile collector's
+    directory in production."""
+    return os.environ.get(_METRICS_DIR_ENV_VAR) or get_telemetry_dir()
+
+
+def is_history_enabled() -> bool:
+    """Cross-run history recording (:mod:`tpusnap.history`): every
+    COMPLETED take/restore appends one summary line to the per-host
+    ``TPUSNAP_TELEMETRY_DIR/history.jsonl`` (size-bounded, crash-
+    tolerant), queryable by ``python -m tpusnap history`` and its
+    ``--check`` regression gate. ``TPUSNAP_HISTORY=0`` disables the
+    append (the file is never written)."""
+    return os.environ.get(_HISTORY_ENV_VAR, "1") != "0"
+
+
+def get_history_max_bytes() -> int:
+    """Size bound on history.jsonl: when an append pushes the file past
+    this, the oldest lines are compacted away (newest kept, atomic
+    rewrite). Floor of 64 KiB so a misconfigured bound cannot thrash
+    every append."""
+    return max(
+        64 * 1024, _get_int_env(_HISTORY_MAX_BYTES_ENV_VAR, 4 * 1024 * 1024)
+    )
+
+
 def get_memory_budget_override_bytes() -> Optional[int]:
     if _MEMORY_BUDGET_ENV_VAR not in os.environ:
         return None
@@ -348,4 +417,28 @@ def override_heartbeat_interval_s(seconds: float) -> Generator[None, None, None]
 @contextlib.contextmanager
 def override_telemetry_dir(path: str) -> Generator[None, None, None]:
     with _override_env(_TELEMETRY_DIR_ENV_VAR, path):
+        yield
+
+
+@contextlib.contextmanager
+def override_metrics_export(formats: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(_METRICS_EXPORT_ENV_VAR, formats):
+        yield
+
+
+@contextlib.contextmanager
+def override_metrics_dir(path: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(_METRICS_DIR_ENV_VAR, path):
+        yield
+
+
+@contextlib.contextmanager
+def override_history_enabled(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(_HISTORY_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_history_max_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_HISTORY_MAX_BYTES_ENV_VAR, str(nbytes)):
         yield

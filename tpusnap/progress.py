@@ -63,6 +63,12 @@ logger = logging.getLogger(__name__)
 
 PROGRESS_DIR = ".tpusnap/progress"
 
+# Wall-clock seam: record timestamps only; every duration/throttle
+# computation here runs on the injectable monotonic ``clock`` — direct
+# wall-clock calls are lint-forbidden in this file
+# (tests/test_knob_docs.py); only this bare reference is allowed.
+_wall = time.time
+
 # Keep-alive: with NO observable change, a record is still re-published
 # every this-many intervals so `watch` can distinguish "idle but alive"
 # from "process gone" (record timestamp goes stale).
@@ -243,6 +249,16 @@ class ProgressMonitor:
                 missing = got
                 break
         self._stall_warned = True  # one WARNING per stall episode
+        # Surface the episode to the take summary/rollup, the export
+        # sinks (tpusnap_stall_episodes_total) and the cross-run
+        # history: an explicit rec so the counter lands in THIS take
+        # even when a newer take replaced the global recorder.
+        try:
+            from . import telemetry
+
+            telemetry.incr("progress.stall_episodes", rec=self.tele)
+        except Exception:
+            pass
         info = {
             "rank": self.rank,
             "take_id": self.take_id,
@@ -487,7 +503,7 @@ def render_watch_table(
     """One frame of the ``tpusnap watch`` table. ``stall_flag_s`` flags
     ranks whose heartbeat has not advanced for that long (record
     beat_age plus how stale the record itself is)."""
-    now = time.time() if now is None else now
+    now = _wall() if now is None else now
     lines = [
         f"{'rank':>4}  {'state':<10} {'phase':<16} {'op':<20} "
         f"{'%':>6} {'MB/s':>8} {'beat':>7}"

@@ -285,8 +285,11 @@ class Snapshot:
                 abort_ctx.mark_commit_started()
                 _write_metadata(storage, metadata, event_loop)
             comm.barrier()
-            # Commit is definitive: publish the final heartbeat (100%)
-            # and stop the pump before the handle is returned.
+            # Commit is definitive: mark the take completed (end_take
+            # publishes only completed takes to the cross-run history),
+            # publish the final heartbeat (100%) and stop the pump
+            # before the handle is returned.
+            tele.meta["completed"] = True
             tele_commit.finish_progress()
             if comm.rank == 0:
                 # Metadata committed and every rank departed: the take
@@ -454,17 +457,21 @@ class Snapshot:
         # spans. The snapshot is immutable, so the trace persists to
         # the LOCAL trace dir (TPUSNAP_TELEMETRY_DIR) — rendered by
         # `python -m tpusnap trace --restore <path>`.
-        tele = telemetry.TakeTelemetry(comm.rank)
+        tele = telemetry.begin_restore(comm.rank)
+        tele.meta.update(path=self.path, world_size=comm.world_size)
         mark = telemetry.PhaseMarker(rec=tele, from_start=True)
         try:
             with telemetry.use(tele):
                 self._restore_instrumented(
                     app_state, comm, per_key_barrier, memory_budget, mark
                 )
+            # Only a restore that ran to completion becomes a history
+            # trend point; the summary itself still publishes either way.
+            tele.meta["completed"] = True
         finally:
             tele.finalize()
             summary = tele.summary()
-            telemetry.LAST_RESTORE_SUMMARY = summary
+            telemetry.publish_restore_summary(summary)
             if tele.enabled:
                 try:
                     from .progress import persist_restore_trace
@@ -934,6 +941,17 @@ def _take_impl(
     # the glob intersection — cheap, but keeping the phases contiguous
     # is what makes coverage meaningful).
     mark("plan")
+    if mark.rec is not None:
+        # Identity context for the summary consumers (export sinks,
+        # cross-run history): take_id and the coalesced path are final
+        # here. ``completed`` is set by the caller strictly after the
+        # commit.
+        mark.rec.meta.update(
+            take_id=take_id,
+            path=path,
+            world_size=comm.world_size,
+            incremental=incremental_from is not None,
+        )
 
     storage = url_to_storage_plugin_in_event_loop(
         path, event_loop, storage_options
@@ -2035,6 +2053,10 @@ class PendingSnapshot(_BackgroundWork):
             pass
         if self._tele_commit is not None:
             self._tele_commit.finish_progress()
+            if self._tele_commit.tele is not None:
+                # Commit done: eligible for the cross-run history when
+                # _cleanup's end_take publishes the summary.
+                self._tele_commit.tele.meta["completed"] = True
         snapshot = Snapshot(self.path, self._storage_options, self._comm)
         if self._comm.rank == 0:
             snapshot._metadata = self._metadata

@@ -43,6 +43,7 @@ must never fail a take.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from contextlib import contextmanager
@@ -50,7 +51,15 @@ from typing import Any, Callable, Dict, Generator, List, Optional, Tuple
 
 from .knobs import is_telemetry_enabled
 
+logger = logging.getLogger(__name__)
+
 TELEMETRY_DIR = ".tpusnap/telemetry"
+
+# Wall-clock seam: timestamps only (started_at); ALL duration math in
+# this file is monotonic — direct wall-clock CALLS are lint-forbidden
+# here (tests/test_knob_docs.py enforces the invariant); only this bare
+# reference is allowed.
+_wall = time.time
 
 # Summary of the most recent completed take in this process (set by
 # end_take); benchmarks read this to embed the stage breakdown in their
@@ -86,9 +95,22 @@ class MetricsSink:
     def on_take_summary(self, summary: Dict[str, Any]) -> None:
         pass
 
+    def on_restore_summary(self, summary: Dict[str, Any]) -> None:
+        pass
+
 
 _sinks: Tuple[MetricsSink, ...] = ()
 _sinks_lock = threading.Lock()
+# (sink class name, callback name) pairs already warned about since the
+# last take/restore began — a broken exporter logs ONE rate-limited
+# WARNING per sink class per callback per take instead of being
+# silently invisible (or spamming once per span).
+_sink_warned: set = set()
+
+
+def _reset_sink_warnings() -> None:
+    with _sinks_lock:
+        _sink_warned.clear()
 
 
 def register_metrics_sink(sink: MetricsSink) -> None:
@@ -121,7 +143,24 @@ def _notify(method: str, *args) -> None:
         try:
             getattr(sink, method)(*args)
         except Exception:
-            pass
+            # Swallowed (telemetry never fails a take) but NOT silent: a
+            # broken exporter is diagnosable from one WARNING naming the
+            # sink class and callback, rate-limited to once per sink
+            # class per callback per take.
+            key = (type(sink).__name__, method)
+            with _sinks_lock:
+                first = key not in _sink_warned
+                _sink_warned.add(key)
+            if first:
+                logger.warning(
+                    "MetricsSink %s.%s raised; exception swallowed "
+                    "(telemetry never fails a take) — further failures "
+                    "from this sink/callback suppressed until the next "
+                    "take",
+                    key[0],
+                    method,
+                    exc_info=True,
+                )
 
 
 # ---------------------------------------------------- global counters
@@ -136,6 +175,14 @@ _counters_lock = threading.Lock()
 def counter_value(name: str) -> int:
     with _counters_lock:
         return _global_counters.get(name, 0)
+
+
+def global_counters_snapshot() -> Dict[str, int]:
+    """Copy of the process-lifetime counters — the monotonic domain the
+    Prometheus textfile sink exports (take-local counters reset per
+    take and would break ``rate()``)."""
+    with _counters_lock:
+        return dict(_global_counters)
 
 
 def reset_global_counters() -> None:
@@ -159,7 +206,13 @@ class TakeTelemetry:
         self.rank = rank
         self.enabled = is_telemetry_enabled() if enabled is None else enabled
         self.t0 = time.monotonic()
-        self.wall0 = time.time()
+        self.wall0 = _wall()
+        # Identity/outcome context merged into summary(): the take path
+        # sets kind/take_id/path/world_size once they're agreed, and
+        # completed=True strictly after the commit — the history store
+        # and export sinks key off these (an aborted take must not
+        # become a throughput trend point).
+        self.meta: Dict[str, Any] = {}
         self._lock = threading.Lock()
         # (name, start_s, dur_s, thread_name, is_phase, attrs)
         self._spans: List[Tuple[str, float, float, str, bool, Dict[str, Any]]] = []
@@ -345,6 +398,7 @@ class TakeTelemetry:
         take_wall = self.take_wall_s
         phase_sum = sum(phase_total.values())
         return {
+            **self.meta,
             "rank": self.rank,
             "enabled": self.enabled,
             "started_at": self.wall0,
@@ -428,13 +482,41 @@ def current() -> Optional[TakeTelemetry]:
     return rec if rec is not None else _global_current
 
 
+def _begin_common() -> None:
+    # Fresh take/restore: re-arm the one-warning-per-sink budget and
+    # reconcile env-driven export sinks (TPUSNAP_METRICS_EXPORT may
+    # have changed since the last take; best-effort, never fatal).
+    _reset_sink_warnings()
+    try:
+        from .metrics_export import install_env_sinks
+
+        install_env_sinks()
+    except Exception:
+        logger.warning(
+            "Failed to install metrics export sinks (non-fatal)",
+            exc_info=True,
+        )
+
+
 def begin_take(rank: int) -> TakeTelemetry:
     """Create a take recorder and install it as the process-global
     current. Pipeline layers then record through the module-level
     span()/incr()/event() without threading a handle."""
     global _global_current
+    _begin_common()
     rec = TakeTelemetry(rank)
+    rec.meta["kind"] = "take"
     _global_current = rec
+    return rec
+
+
+def begin_restore(rank: int) -> TakeTelemetry:
+    """Create a restore recorder (NOT installed globally — restores
+    overlay it thread-locally via :func:`use` so an in-flight take's
+    global recorder is never disturbed)."""
+    _begin_common()
+    rec = TakeTelemetry(rank)
+    rec.meta["kind"] = "restore"
     return rec
 
 
@@ -450,13 +532,35 @@ def release_global(rec: TakeTelemetry) -> None:
 
 def end_take(rec: TakeTelemetry) -> None:
     """Finalize + uninstall (only if still installed) and publish the
-    summary to LAST_TAKE_SUMMARY and the sinks' on_take_summary."""
+    summary: LAST_TAKE_SUMMARY, the sinks' on_take_summary, and — for
+    COMPLETED takes only — one cross-run history event."""
     global LAST_TAKE_SUMMARY
     rec.finalize()
     release_global(rec)
     summary = rec.summary()
     LAST_TAKE_SUMMARY = summary
     _notify("on_take_summary", summary)
+    try:
+        from .history import record_summary
+
+        record_summary("take", summary)
+    except Exception:
+        logger.debug("history record failed", exc_info=True)
+
+
+def publish_restore_summary(summary: Dict[str, Any]) -> None:
+    """Restore-side counterpart of :func:`end_take`'s publication step:
+    LAST_RESTORE_SUMMARY, the sinks' on_restore_summary, and — for
+    completed restores — one history event."""
+    global LAST_RESTORE_SUMMARY
+    LAST_RESTORE_SUMMARY = summary
+    _notify("on_restore_summary", summary)
+    try:
+        from .history import record_summary
+
+        record_summary("restore", summary)
+    except Exception:
+        logger.debug("history record failed", exc_info=True)
 
 
 @contextmanager
