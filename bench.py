@@ -26,11 +26,14 @@ result is interpretable on any disk:
   on the pipeline.
 - ``staging_s`` / ``residual_io_s``: the scheduler's split of the best
   take (staging = the window training would be blocked in async_take).
-- ``restore_gbps`` / ``restore_warm_gbps``: full-scale ABSOLUTES —
+- ``restore_cold_gbps`` / ``restore_warm_gbps``: full-scale ABSOLUTES —
   fresh-target cold restores and warm-target (production resume-loop)
   restores. No fractions are formed at full scale: a 20 GB sample
   spans minutes and the virtio disk drifts several-fold within that,
-  so no two full-scale measurements share a window.
+  so no two full-scale measurements share a window. The restore
+  HEADLINES are ``restore_verified_fraction`` + ``restore_warm_gbps``;
+  the cold absolute is a demoted diagnostic (``restore_gbps`` remains
+  as a deprecated alias for BENCH_r* trend comparability).
 - ``restore_verified_fraction`` — the pipeline-efficiency number,
   from a tight-window ~2 GB probe where each paired sample takes
   seconds: median over rounds of (warm-target restore) /
@@ -68,11 +71,14 @@ Memory accounting: ``async_take_peak_rss_mb`` is the peak RSS delta
 (rss_profiler, 100 ms sampling) over one async take at bench scale —
 the defensive-clone path, where RSS MUST move, so the field doubles as
 the sampler's self-check (the former sync-take take_peak_rss_mb was
-pinned at ~0 by zero-copy staging and carried no information) —
-alongside ``async_take_blocked_s`` (the staging-priority blocked
-window) and ``memory_budget_gb``, the scheduler budget the takes ran
-under — together the evidence for the reference's signature "adapts to
-host RAM" property (reference benchmarks/load_tensor/main.py:39-44).
+pinned at ~0 by zero-copy staging and carried no information). Under
+PIPELINED staging the delta is bounded by the staging window
+(``async_stage_window_gb``), not 1x state; ``async_take_blocked_s`` is
+the first-window blocked window, with ``async_blocked_vs_sync_take``
+and ``async_breakeven_overlap_s`` the sync/async crossover pair —
+together with ``memory_budget_gb`` the evidence for the reference's
+signature "adapts to host RAM" property (reference
+benchmarks/load_tensor/main.py:39-44).
 Set TPUSNAP_BENCH_BYTES to shrink the run below the default
 baseline-scale 20 GB.
 
@@ -449,23 +455,25 @@ def main() -> None:
             ),
         }
 
-        # Async-take leg at bench scale: the blocked window (under
-        # staging-priority scheduling this is the defensive-clone pass)
-        # and its peak RSS. This replaces the former sync-take
+        # Async-take leg at bench scale: the blocked window — under
+        # PIPELINED staging this is the first-window clone pass, not the
+        # full-state clone — and its peak RSS (bounded by the staging
+        # window, not 1x state). The leg replaces the former sync-take
         # take_peak_rss_mb, which was pinned at ~0 by design (sync
         # takes of numpy state stage zero-copy views) and therefore
         # indistinguishable from a broken sampler — the async clone
         # path is the configuration where RSS MUST move, so the field
         # doubles as the sampler's self-check.
         #
-        # Two takes: COLD (pool empty — every clone pays first-touch
-        # faulting) and WARM (the steady-state checkpoint loop: clones
-        # reuse the previous take's parked pages). The pool is sized to
-        # the state for the leg — the production guidance for async
-        # loops (the 4 GiB default would recycle only a fifth of a
-        # 20 GB clone set and keep every take mostly cold).
-        prev_pool = os.environ.get("TPUSNAP_STAGING_POOL_BYTES")
-        os.environ["TPUSNAP_STAGING_POOL_BYTES"] = str(nbytes + (1 << 28))
+        # Two takes: COLD (pool empty — the first window's clones pay
+        # first-touch faulting; later windows already recycle the
+        # buffers earlier writes released) and WARM (the steady-state
+        # checkpoint loop: even window 0 reuses the previous take's
+        # parked pages). The default 4 GiB pool covers the 2 GiB
+        # default window with room — windowed staging is what made the
+        # old state-sized pool override unnecessary.
+        from tpusnap.knobs import get_async_stage_window_bytes
+
         try:
             async_blocked = []
             async_total = []
@@ -487,14 +495,11 @@ def main() -> None:
                     os.path.dirname(async_dir), ignore_errors=True
                 )
             async_peak_rss = max(rss_deltas, default=0)
+            async_window_bytes = get_async_stage_window_bytes() or 0
         finally:
-            if prev_pool is None:
-                os.environ.pop("TPUSNAP_STAGING_POOL_BYTES", None)
-            else:
-                os.environ["TPUSNAP_STAGING_POOL_BYTES"] = prev_pool
             from tpusnap import _staging_pool as _sp
 
-            _sp.clear()  # release the bench-sized pool
+            _sp.clear()  # release the window-sized pool
 
         # Beyond-reference capabilities, measured on the last snapshot:
         # an incremental take of the UNCHANGED state (all blobs dedup —
@@ -769,14 +774,24 @@ def main() -> None:
             if staging_s and sched_total_s
             else None
         ),
-        "restore_gbps": round(restore_gbps, 3),
-        # Median of per-round like-for-like pairs from the
-        # tight-window probe: warm restore / prefaulted+CRC
-        # engine reads — neither side faults pages, both
-        # checksum every byte, both in one disk window.
+        # RESTORE HEADLINES are the verified-fraction pair below:
+        # the fraction (pipeline efficiency, like-for-like paired
+        # samples) and the warm absolute (the production
+        # resume-loop). The cold absolute was demoted to
+        # restore_cold_gbps (ROADMAP 5d): a 20 GB cold sample
+        # spans minutes of drifting virtio bandwidth and page-cache
+        # state, so it reads as a disk-weather report, not a
+        # pipeline verdict.
         "restore_verified_fraction": round(
             statistics.median(restore_verified_fracs), 3
         ),
+        "restore_warm_gbps": round(
+            nbytes / min(restore_warm_runs) / 1e9, 3
+        ),
+        "restore_cold_gbps": round(restore_gbps, 3),
+        # Deprecated alias of restore_cold_gbps, kept so BENCH_r01-r05
+        # trend tooling and the cross-run history stay comparable.
+        "restore_gbps": round(restore_gbps, 3),
         "restore_verified_fraction_runs": [
             round(f, 3) for f in restore_verified_fracs
         ],
@@ -785,9 +800,6 @@ def main() -> None:
         ],
         "restore_runs_s": [round(t, 2) for t in restore_runs],
         "restore_stage_breakdown": restore_stage_breakdown,
-        "restore_warm_gbps": round(
-            nbytes / min(restore_warm_runs) / 1e9, 3
-        ),
         "restore_warm_runs_s": [
             round(t, 2) for t in restore_warm_runs
         ],
@@ -795,14 +807,29 @@ def main() -> None:
         "restore_cold_cache": cold,
         "restore_verified": ok,
         # Warm = the steady-state checkpoint loop (pool pages
-        # reused); cold = first take of the process.
+        # reused); cold = first take of the process. Under pipelined
+        # staging the blocked window is O(stage window), not O(state).
         "async_take_blocked_s": round(async_blocked[-1], 2),
         "async_take_blocked_cold_s": round(async_blocked[0], 2),
         "async_take_total_s": round(async_total[-1], 2),
-        # Clone-path RSS: must be >> 0 (the defensive clones are
-        # real allocations) — doubles as the RSS sampler's
-        # self-check, unlike the sync take whose zero-copy
-        # staging pinned the old take_peak_rss_mb at 0.
+        "async_stage_window_gb": round(async_window_bytes / 1e9, 2),
+        # Sync/async crossover, both sides from this run: the blocked
+        # window is blocked_vs_sync of a sync take (training-visible
+        # cost ratio), and async is the net win whenever the training
+        # work overlapped with the background drain exceeds
+        # breakeven_overlap_s (the drain's wall-clock excess over a
+        # sync take). See BENCHMARKS.md "Sync/async crossover".
+        "async_blocked_vs_sync_take": round(
+            async_blocked[-1] / min(_warm(times)), 4
+        ),
+        "async_breakeven_overlap_s": round(
+            max(async_total[-1] - min(_warm(times)), 0.0), 2
+        ),
+        # Clone-path RSS: must be >> 0 (the windowed clones are real
+        # allocations) but BOUNDED by the staging window — no longer
+        # ~1x state; still the RSS sampler's self-check, unlike the
+        # sync take whose zero-copy staging pinned the old
+        # take_peak_rss_mb at 0.
         "async_take_peak_rss_mb": round(async_peak_rss / 1e6),
         "memory_budget_gb": (
             round(budget_bytes / 1e9, 2) if budget_bytes else None
@@ -862,6 +889,8 @@ def main() -> None:
                 "restore_verified_fraction": result[
                     "restore_verified_fraction"
                 ],
+                "async_take_blocked_s": result["async_take_blocked_s"],
+                "async_take_peak_rss_mb": result["async_take_peak_rss_mb"],
                 "scrub_gbps": result["scrub_gbps"],
                 "incremental_effective_gbps": result[
                     "incremental_effective_gbps"

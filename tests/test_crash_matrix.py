@@ -376,6 +376,87 @@ def test_salvage_resume_of_torn_take(tmp_path, seed, crash_at):
     assert verify_snapshot(sibling).clean
 
 
+_PIPELINED_DRAIN_CHILD = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from tpusnap import Snapshot, StateDict
+
+path, seed, crash_at = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+os.environ["TPUSNAP_DISABLE_BATCHING"] = "1"
+# Tight staging window: async_take returns control after ~one blob and
+# the deterministic SIGKILL (after the crash_at-th successful blob
+# write) lands inside the BACKGROUND drain of the residual windows.
+os.environ["TPUSNAP_ASYNC_STAGE_WINDOW_BYTES"] = str(1 << 19)
+state = {
+    f"w{i}": np.random.default_rng(seed * 1000 + i)
+    .standard_normal((256, 256))
+    .astype(np.float32)
+    for i in range(12)
+}
+pending = Snapshot.async_take(
+    "chaos+fs://" + path,
+    {"app": StateDict(**state)},
+    storage_options={"fault_plan": {"seed": seed, "crash_after_op": ("write", crash_at)}},
+)
+print("RETURNED", flush=True)
+pending.wait()
+print("UNEXPECTED_COMPLETION", flush=True)
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.pipelined
+def test_sigkill_in_pipelined_async_drain_is_torn_and_salvageable(tmp_path):
+    """SIGKILL inside the background drain of a PIPELINED async take
+    (control already returned to "training", residual windows still
+    staging/writing): fsck classifies the debris as torn, and a retake
+    salvage-resumes the windows the drain had already written instead
+    of rewriting them from byte zero."""
+    from tpusnap.knobs import override_batching_disabled
+    from tpusnap.lifecycle import fsck_snapshot
+
+    seed, crash_at = 3, 6
+    path = str(tmp_path / "snap")
+    expected = _expected_state(seed)
+
+    rc, out = _take_to_completion_or_kill(
+        _PIPELINED_DRAIN_CHILD, [path, str(seed), str(crash_at)]
+    )
+    assert rc == -signal.SIGKILL, (rc, out[-2000:])
+    # The kill landed AFTER control returned (the pipelined contract)
+    # and before the commit.
+    assert "RETURNED" in out, out[-2000:]
+    assert "UNEXPECTED_COMPLETION" not in out, out[-2000:]
+
+    report = fsck_snapshot(path)
+    assert report.state == "torn", report.summary()
+    assert report.salvage_records >= crash_at // 2, report.summary()
+    assert report.salvage_bytes_present > 0
+
+    import tpusnap.telemetry as telemetry
+
+    before = telemetry.counter_value("salvage.bytes_salvaged")
+    with override_batching_disabled(True):
+        Snapshot.take(path, {"app": StateDict(**expected)})
+    salvaged = telemetry.counter_value("salvage.bytes_salvaged") - before
+    # The already-written windows were reused, not rewritten.
+    assert salvaged >= 0.5 * report.salvage_bytes_present, (
+        salvaged,
+        report.salvage_bytes_present,
+    )
+    assert fsck_snapshot(path).state == "committed"
+    target = {
+        "app": StateDict(**{k: np.zeros_like(v) for k, v in expected.items()})
+    }
+    Snapshot(path).restore(target)
+    for k, v in expected.items():
+        assert np.array_equal(target["app"][k], v), k
+    assert verify_snapshot(path).clean
+
+
 _GC_CHILD = r"""
 import os, sys, time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -1,0 +1,492 @@
+"""Pipelined chunk-grain async staging (fast tier-1 suite, marker
+``pipelined``).
+
+The contract under test: an ``async_take`` of a state larger than
+TPUSNAP_ASYNC_STAGE_WINDOW_BYTES returns control after staging ONE
+window — blocked time and resident clone bytes are O(window), the
+residual windows clone on the background drain interleaved with their
+storage I/O, and the committed snapshot is bit-exact regardless. Plus
+the opt-in COW mode (hash-verify-at-write instead of cloning) and the
+``async_blocked_s`` history/regression wiring.
+"""
+
+import asyncio
+import glob
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpusnap import PytreeState, Snapshot, StateDict
+from tpusnap import telemetry as tele_mod
+from tpusnap.io_types import BufferStager, WriteReq
+from tpusnap.knobs import (
+    override_async_cow,
+    override_async_stage_window_bytes,
+    override_batching_disabled,
+    override_journal_disabled,
+    override_memory_budget_bytes,
+    override_stage_threads,
+)
+from tpusnap.scheduler import execute_write_reqs
+from tpusnap.storage_plugins.fs import FSStoragePlugin
+
+pytestmark = pytest.mark.pipelined
+
+_N = 8
+_PER = 1 << 18  # 256 KiB per array; async staging cost is 2x
+
+
+def _state(n=_N, per=_PER, seed=7):
+    return {
+        f"w{i}": np.random.default_rng(seed * 100 + i)
+        .integers(0, 255, per, dtype=np.uint8)
+        .view(np.float32)
+        for i in range(n)
+    }
+
+
+def _blob_files(root):
+    return [
+        f
+        for f in glob.glob(os.path.join(root, "**", "*"), recursive=True)
+        if os.path.isfile(f)
+        and ".tpusnap" not in f.split(os.sep)
+        and not f.endswith(".snapshot_metadata")
+    ]
+
+
+def _restore_and_check(path, state):
+    tgt = {"m": PytreeState({k: np.zeros_like(v) for k, v in state.items()})}
+    Snapshot(path).restore(tgt)
+    for k, v in state.items():
+        assert np.array_equal(tgt["m"].tree[k].view(np.uint8), v.view(np.uint8)), k
+
+
+# ------------------------------------------------------ scheduler-level
+
+
+class _UnitStager(BufferStager):
+    live = 0
+    peak = 0
+
+    def __init__(self, data):
+        self.data = data
+
+    async def stage_buffer(self, executor=None):
+        _UnitStager.live += 1
+        _UnitStager.peak = max(_UnitStager.peak, _UnitStager.live)
+        await asyncio.sleep(0.002)
+        return self.data
+
+    def get_staging_cost_bytes(self) -> int:
+        return len(self.data)
+
+
+def test_pipelined_execute_returns_at_first_window(tmp_path):
+    """The engine hands back a resumable PendingIOWork once one window's
+    worth of staging cost is staged; complete() stages the rest under
+    the window bound and writes everything."""
+    _UnitStager.live = 0
+    _UnitStager.peak = 0
+    unit = 1000
+
+    class DecPlugin(FSStoragePlugin):
+        async def write(self, write_io) -> None:
+            await asyncio.sleep(0.005)
+            await super().write(write_io)
+            _UnitStager.live -= 1
+
+    plugin = DecPlugin(root=str(tmp_path))
+    write_reqs = [
+        WriteReq(path=f"b{i}", buffer_stager=_UnitStager(os.urandom(unit)))
+        for i in range(10)
+    ]
+
+    async def go():
+        pending = await execute_write_reqs(
+            write_reqs,
+            plugin,
+            memory_budget_bytes=1 << 30,
+            rank=0,
+            pipelined_staging=True,
+        )
+        # Window = 2 units: staging must NOT have completed at return.
+        assert not pending.staging_complete()
+        staged_at_return = _UnitStager.peak
+        assert staged_at_return <= 3  # window (2) + the >=1 admission
+        await pending.complete()
+        assert pending.staging_complete()
+
+    with override_async_stage_window_bytes(2 * unit):
+        asyncio.run(go())
+    for i in range(10):
+        assert (tmp_path / f"b{i}").exists()
+    # Resident staged-but-unwritten buffers stayed window-bounded
+    # through the drain too.
+    assert _UnitStager.peak <= 3, f"window unenforced: peak {_UnitStager.peak}"
+
+
+def test_pipelined_stage_eagerly_requests_stage_in_blocked_window(tmp_path):
+    """Requests selected by stage_eagerly (stage-time manifest
+    annotators on multi-process takes) stage before control returns,
+    even past the window target."""
+    staged = []
+
+    class S(BufferStager):
+        def __init__(self, name, data):
+            self.name = name
+            self.data = data
+
+        async def stage_buffer(self, executor=None):
+            staged.append(self.name)
+            return self.data
+
+        def get_staging_cost_bytes(self) -> int:
+            return len(self.data)
+
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    write_reqs = [
+        WriteReq(path=f"e{i}", buffer_stager=S(f"e{i}", os.urandom(500)))
+        for i in range(4)
+    ] + [
+        WriteReq(path=f"d{i}", buffer_stager=S(f"d{i}", os.urandom(500)))
+        for i in range(4)
+    ]
+
+    async def go():
+        pending = await execute_write_reqs(
+            write_reqs,
+            plugin,
+            memory_budget_bytes=1 << 30,
+            rank=0,
+            pipelined_staging=True,
+            stage_eagerly=lambda wr: wr.path.startswith("e"),
+        )
+        at_return = list(staged)
+        assert {f"e{i}" for i in range(4)} <= set(at_return), at_return
+        await pending.complete()
+
+    with override_async_stage_window_bytes(1000):
+        asyncio.run(go())
+
+
+def test_stage_eagerly_holds_window_open_across_threads(tmp_path):
+    """Completed NON-eager stagers must not count against the eager
+    set: with TPUSNAP_STAGE_THREADS=2, fast non-eager stagers that
+    overshoot the window target while a slow eager stager is still in
+    flight may not close the blocked window early."""
+    staged = []
+
+    class S(BufferStager):
+        def __init__(self, name, data, delay):
+            self.name = name
+            self.data = data
+            self.delay = delay
+
+        async def stage_buffer(self, executor=None):
+            await asyncio.sleep(self.delay)
+            staged.append(self.name)
+            return self.data
+
+        def get_staging_cost_bytes(self) -> int:
+            return len(self.data)
+
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    # One slow eager annotator + fast non-eager bulk whose cost alone
+    # exceeds the window target.
+    write_reqs = [
+        WriteReq(path="eager", buffer_stager=S("eager", os.urandom(400), 0.15))
+    ] + [
+        WriteReq(path=f"d{i}", buffer_stager=S(f"d{i}", os.urandom(600), 0.001))
+        for i in range(6)
+    ]
+
+    async def go():
+        pending = await execute_write_reqs(
+            write_reqs,
+            plugin,
+            memory_budget_bytes=1 << 30,
+            rank=0,
+            pipelined_staging=True,
+            stage_eagerly=lambda wr: wr.path == "eager",
+        )
+        assert "eager" in staged, f"window closed mid-eager: {staged}"
+        await pending.complete()
+
+    with override_stage_threads(2), override_async_stage_window_bytes(1200):
+        asyncio.run(go())
+
+
+# ------------------------------------------------------- take-level (a)
+
+
+def test_blocked_window_is_budget_bounded(tmp_path):
+    """Satellite (a): an async take of N windows under a tight memory
+    budget keeps peak staged bytes <= budget (budget high-water gauge)
+    and returns control BEFORE all blobs exist on disk; the commit then
+    completes and restores bit-exact."""
+    state = _state()
+    budget = 2 * 2 * _PER  # two in-flight clones (async cost is 2x)
+    path = str(tmp_path / "snap")
+    with override_batching_disabled(True), override_journal_disabled(
+        True
+    ), override_memory_budget_bytes(budget):
+        pending = Snapshot.async_take(
+            "chaos+fs://" + path,
+            {"m": PytreeState(state)},
+            # Every write stalls 0.6 s inside the op: nothing can land
+            # on disk within the blocked window's return path.
+            storage_options={"fault_plan": {"stall_op": ("write", 0, 0.6)}},
+        )
+        # Control is back before the drain produced all blobs (or any
+        # metadata): the pipelined window is doing its job.
+        assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+        assert len(_blob_files(path)) < _N
+        snap = pending.wait()
+        assert pending.staged()
+    summary = tele_mod.LAST_TAKE_SUMMARY
+    high_water = summary["gauges"]["scheduler.budget_used_bytes"]
+    assert high_water <= budget, (high_water, budget)
+    assert summary["counters"]["scheduler.bytes_staged"] == _N * _PER
+    assert os.path.exists(os.path.join(path, ".snapshot_metadata"))
+    _restore_and_check(snap.path, state)
+
+
+def test_window_fits_state_keeps_strict_semantics(tmp_path):
+    """States at or under the window stage COMPLETELY inside the
+    blocked window — the pre-pipeline consistency contract (mutate
+    in place right after return) holds exactly, as does window=0."""
+    state = _state(n=3)
+    for window in (1 << 30, 0):
+        path = str(tmp_path / f"snap{window}")
+        with override_async_stage_window_bytes(window):
+            pending = Snapshot.async_take(path, {"m": PytreeState(state)})
+            assert pending.staged()  # frozen before control returned
+            # "Training step": in-place mutation while I/O drains.
+            mutated = {k: v.copy() for k, v in state.items()}
+            for v in state.values():
+                v.view(np.uint8)[:] = 0xAB
+            pending.wait()
+            _restore_and_check(path, mutated)
+            for k, v in mutated.items():  # restore sources for next loop
+                state[k][:] = v
+
+
+def test_stall_in_drain_does_not_extend_blocked_window(tmp_path):
+    """Satellite (c): a chaos ``stall`` fault on every storage write
+    (the background drain's leg) must not extend the blocked window —
+    writes are gated out of it entirely."""
+    state = _state()
+    stall_s = 1.2
+    path = str(tmp_path / "snap")
+    with override_batching_disabled(True), override_journal_disabled(
+        True
+    ), override_async_stage_window_bytes(2 * 2 * _PER):
+        t0 = time.perf_counter()
+        pending = Snapshot.async_take(
+            "chaos+fs://" + path,
+            {"m": PytreeState(state)},
+            storage_options={
+                "fault_plan": {"stall_op": ("write", 0, stall_s)}
+            },
+        )
+        blocked = time.perf_counter() - t0
+        pending.wait()
+    assert blocked < stall_s, (
+        f"blocked window {blocked:.2f}s swallowed the drain's "
+        f"{stall_s}s write stall"
+    )
+    summary = tele_mod.LAST_TAKE_SUMMARY
+    assert summary["async_blocked_s"] < stall_s
+    _restore_and_check(path, state)
+
+
+def test_single_stage_thread_by_default(tmp_path, monkeypatch):
+    """Satellite: the clone executor is sized by TPUSNAP_STAGE_THREADS
+    (default 1 — interleaved clone threads measured slower than one),
+    not hardcoded."""
+    from tpusnap.knobs import get_stage_threads
+    from tpusnap.scheduler import _WriteScheduler
+
+    # The ambient environment may legitimately set the knob (TPU-VM
+    # operators are told to); the DEFAULT is what's under test.
+    monkeypatch.delenv("TPUSNAP_STAGE_THREADS", raising=False)
+    assert get_stage_threads() == 1
+    with override_stage_threads(3):
+        sched = _WriteScheduler(
+            [], FSStoragePlugin(root=str(tmp_path)), 1 << 20, rank=0
+        )
+        try:
+            assert sched.stage_concurrency == 3
+            assert sched.executor._max_workers == 3
+        finally:
+            sched.executor.shutdown(wait=False)
+            sched.hash_executor.shutdown(wait=False)
+
+
+def test_warm_pool_reuse_across_windows(tmp_path):
+    """Steady-state windows allocate nothing: window N+1's clones reuse
+    the buffers window N's writes released (pool high-water stays at
+    about one window, not the state size)."""
+    import tpusnap._staging_pool as sp
+
+    sp.clear()
+    state = _state()
+    path = str(tmp_path / "snap")
+    with override_batching_disabled(True), override_journal_disabled(
+        True
+    ), override_async_stage_window_bytes(2 * 2 * _PER):
+        Snapshot.async_take(path, {"m": PytreeState(state)}).wait()
+    try:
+        # All clones parked back; far fewer distinct buffers than blobs.
+        assert 0 < sp.free_bytes() < _N * _PER, sp.free_bytes()
+    finally:
+        sp.clear()
+    _restore_and_check(path, state)
+
+
+# ------------------------------------------------------------ COW mode
+
+
+def test_cow_frozen_state_clones_nothing(tmp_path):
+    """TPUSNAP_ASYNC_COW: unmutated (frozen) arrays are written straight
+    from live memory — the staging pool sees zero clone traffic — and
+    the hash-verify-at-write pass accepts them."""
+    import tpusnap._staging_pool as sp
+
+    sp.clear()
+    state = _state()
+    path = str(tmp_path / "snap")
+    with override_batching_disabled(True), override_async_cow(True):
+        pending = Snapshot.async_take(path, {"m": PytreeState(state)})
+        snap = pending.wait()
+    assert sp.free_bytes() == 0  # no clone buffers were ever acquired
+    summary = tele_mod.LAST_TAKE_SUMMARY
+    assert summary["stages"].get("cow_verify", {}).get("count") == _N
+    _restore_and_check(snap.path, state)
+
+
+def test_cow_detects_concurrent_mutation(tmp_path):
+    """TPUSNAP_ASYNC_COW: mutating an array between staging (hash
+    recorded) and its storage write fails the take loudly — the
+    metadata is never committed, torn bytes are never silently blessed."""
+    state = _state(n=4)
+    path = str(tmp_path / "snap")
+    with override_batching_disabled(True), override_journal_disabled(
+        True
+    ), override_async_cow(True), override_async_stage_window_bytes(
+        2 * _PER
+    ):
+        pending = Snapshot.async_take(
+            "chaos+fs://" + path,
+            {"m": PytreeState(state)},
+            # Every write stalls 1 s: the mutation below lands before
+            # any write reads the live bytes.
+            storage_options={"fault_plan": {"stall_op": ("write", 0, 1.0)}},
+        )
+        # COW-aware rendezvous: staging per-se is done (no clones) but
+        # the live bytes stay aliased until the stalled writes drain —
+        # staged()/wait_staged() must NOT report safe-to-mutate yet.
+        assert not pending.wait_staged(timeout=0.05)
+        assert not pending.staged()
+        for v in state.values():
+            v.view(np.uint8)[:] = 0x5A
+        with pytest.raises(RuntimeError, match="concurrent mutation"):
+            pending.wait()
+    assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+
+
+def test_cow_verify_checks_xxh64_lane():
+    """verify_cow_after_write re-verifies the 64-bit dedup lane when
+    recorded — a mutation that (hypothetically) collides the 32-bit
+    CRC lane is still caught."""
+    from tpusnap import _native
+    from tpusnap.io_preparers.array import ArrayBufferStager, _record_checksums
+    from tpusnap.manifest import TensorEntry
+
+    data = np.arange(1024, dtype=np.uint8)
+    entry = TensorEntry(
+        location="w", serializer="buffer_protocol", dtype="uint8",
+        shape=[1024], replicated=False, byte_range=None,
+    )
+    _record_checksums(entry, memoryview(data.tobytes()), True)
+    assert entry.dedup_hash or entry.tile_dedup_hashes
+    stager = ArrayBufferStager(data, is_async_snapshot=True, entry=entry)
+    stager.verify_cow_after_write(data.tobytes())  # unmutated: clean
+    mutated = bytearray(data.tobytes())
+    mutated[0] ^= 0xFF
+    with pytest.raises(_native.ChecksumError):
+        # Bypass the CRC lane: the xxh lane alone must catch it.
+        stager._verify_cow_xxh_lane(memoryview(bytes(mutated)))
+
+
+def test_cow_slab_members_verified_against_slab_copy(tmp_path, monkeypatch):
+    """COW + batching: slab members return LIVE bytes and the slab copy
+    is their effective clone — the fill pass must verify the copy
+    against the stage-time hash (the write pipeline only sees the slab
+    stager's cow_pending), so a mutation between the member's hash pass
+    and the slab copy fails the take loudly."""
+    from tpusnap.io_preparers.array import ArrayBufferStager
+
+    # Happy path: small arrays pack into a slab, COW members verify
+    # clean against their slab copy, take commits and restores.
+    state = _state(n=4)
+    path = str(tmp_path / "ok")
+    with override_async_cow(True):
+        snap = Snapshot.async_take(path, {"m": PytreeState(state)}).wait()
+    _restore_and_check(snap.path, state)
+
+    # Mutation between the member's hash pass and the slab copy: wrap
+    # stage_buffer to mutate the live array right after the hash is
+    # recorded (deterministic — no timing race).
+    orig = ArrayBufferStager.stage_buffer
+
+    async def mutate_after_hash(self, executor=None):
+        buf = await orig(self, executor)
+        if getattr(self, "cow_pending", False):
+            np.asarray(self.arr).view(np.uint8)[:1] ^= 0xFF
+        return buf
+
+    monkeypatch.setattr(ArrayBufferStager, "stage_buffer", mutate_after_hash)
+    bad = str(tmp_path / "bad")
+    with override_async_cow(True), override_journal_disabled(True):
+        with pytest.raises(RuntimeError, match="concurrent mutation"):
+            Snapshot.async_take(bad, {"m": PytreeState(_state(n=4))}).wait()
+    assert not os.path.exists(os.path.join(bad, ".snapshot_metadata"))
+
+
+# -------------------------------------------------- history/regression
+
+
+def test_async_blocked_s_recorded_and_gated(tmp_path):
+    """Satellite: async_blocked_s lands in the take summary and the
+    history event, and `history --check` grades it as a duration
+    (upward regressions fire)."""
+    from tpusnap import check_regression
+    from tpusnap import history as hist
+    from tpusnap.knobs import override_telemetry_dir
+
+    state = _state(n=2)
+    with override_telemetry_dir(str(tmp_path / "tele")):
+        hist._reset_process_state()
+        Snapshot.async_take(str(tmp_path / "s"), {"m": PytreeState(state)}).wait()
+        events = hist.load_history()
+        takes = [e for e in events if e.get("kind") == "take"]
+        assert takes and isinstance(takes[-1].get("async_blocked_s"), float)
+
+        # Synthetic trend: a 2x slower blocked window must regress.
+        base = dict(takes[-1], cold=False)
+        evs = []
+        for i in range(5):
+            evs.append(dict(base, async_blocked_s=0.1, ts=i))
+        evs.append(dict(base, async_blocked_s=0.25, ts=9))
+        report = check_regression(
+            evs, kind="take", metric="async_blocked_s", min_baseline=3
+        )
+        assert report.ok and report.regressed, report.reason
+        ok = check_regression(
+            evs[:-1], kind="take", metric="async_blocked_s", min_baseline=3
+        )
+        assert ok.ok and not ok.regressed, ok.reason

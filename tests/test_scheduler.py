@@ -359,8 +359,8 @@ def test_prioritize_staging_defers_io_until_staging_done(tmp_path):
         pending = await execute_write_reqs(
             write_reqs, plugin, 1 << 30, rank=0, prioritize_staging=True
         )
-        assert not pending.io_tasks  # nothing dispatched in the window
-        assert len(pending.pending_pipelines) == 8
+        assert not pending.scheduler.io_tasks  # nothing dispatched in the window
+        assert len(pending.scheduler.ready_for_io) == 8
         await pending.complete()
 
     asyncio.run(go())

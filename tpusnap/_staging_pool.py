@@ -60,7 +60,14 @@ def acquire(nbytes: int) -> np.ndarray:
         dead = [k for k, r in _outstanding.items() if r() is None]
         for k in dead:
             del _outstanding[k]
-        for i, (n, buf) in enumerate(_free):
+        # Newest match first (LIFO): the most recently released buffer
+        # has the warmest pages AND is what makes pipelined staging
+        # windows allocation-free in steady state — window N+1's clone
+        # of a recurring chunk size reuses the buffer window N's write
+        # just released, so a whole multi-GB take touches only one
+        # window's worth of distinct pages.
+        for i in range(len(_free) - 1, -1, -1):
+            n, buf = _free[i]
             if n == nbytes:
                 _free.pop(i)
                 _free_bytes -= n

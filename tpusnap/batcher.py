@@ -96,6 +96,17 @@ class BatchedBufferStager(BufferStager):
                 )
             slab[offset : offset + nbytes] = np.frombuffer(mv, dtype=np.uint8)
             del mv
+            if getattr(stager, "cow_pending", False):
+                # COW members return LIVE bytes; the slab copy above is
+                # their effective clone. The write pipeline only checks
+                # cow_pending on the top-level (slab) stager, so verify
+                # HERE — against the private slab copy, immediately —
+                # that the bytes still match the checksum recorded from
+                # the live array: a mutation between the hash pass and
+                # this copy fails the take loudly instead of committing
+                # a blob whose checksum mismatches its bytes.
+                stager.verify_cow_after_write(slab[offset : offset + nbytes])
+                stager.cow_pending = False
             from ._staging_pool import release
 
             release(buf)  # async member clones reuse warm pages next take
@@ -133,6 +144,11 @@ class BatchedBufferStager(BufferStager):
         # The slab plus transiently one member's own staging cost; the
         # members' buffers are views/DMA targets released as they land.
         return self.total + max((s.get_staging_cost_bytes() for _, _, s in self.members), default=0)
+
+    def get_planned_bytes(self) -> int:
+        # The slab payload itself — members stream through transient
+        # buffers that never count toward written bytes.
+        return self.total
 
 
 class DeviceBatchedBufferStager(BufferStager):
@@ -252,6 +268,10 @@ class DeviceBatchedBufferStager(BufferStager):
             for _, _, s in self.members
         ):
             return 2 * self.total
+        return self.total
+
+    def get_planned_bytes(self) -> int:
+        # The slab payload — never the 2x dedup-compaction budget.
         return self.total
 
 

@@ -126,6 +126,12 @@ def event_from_summary(kind: str, summary: Dict[str, Any]) -> Dict[str, Any]:
         ev["budget_high_water_bytes"] = int(gauges["scheduler.budget_used_bytes"])
     if "peak_rss_delta_bytes" in gauges:
         ev["peak_rss_delta_bytes"] = int(gauges["peak_rss_delta_bytes"])
+    # Async takes: the blocked window (take start → control returned to
+    # training). A *_s metric, so `history --check --metric
+    # async_blocked_s` gates it upward like every other duration — the
+    # pipelined-staging win cannot silently regress.
+    if isinstance(summary.get("async_blocked_s"), (int, float)):
+        ev["async_blocked_s"] = round(float(summary["async_blocked_s"]), 6)
     return ev
 
 

@@ -112,6 +112,13 @@ class ArrayBufferStager(BufferStager):
         # (snapshot._LateChecksums). Incremental dedup needs hashes at
         # stage time and never defers.
         self.defer_checksums = False
+        # Copy-on-write staging (TPUSNAP_ASYNC_COW, opt-in): set by
+        # _stage_blocking when it returns the LIVE host bytes instead of
+        # a defensive clone. The write pipeline then calls
+        # verify_cow_after_write once the storage write completes; a
+        # checksum mismatch (the caller mutated the array mid-take)
+        # fails the take instead of committing torn data.
+        self.cow_pending = False
         # User save-time transform (dtype cast / quantize-on-save),
         # applied to the ORIGINAL array at stage time with tracing=False
         # (reference io_preparers/tensor.py:231-241).
@@ -184,6 +191,13 @@ class ArrayBufferStager(BufferStager):
                 self.arr, host
             )
             if clone:
+                from ..knobs import is_async_cow_enabled
+
+                if is_async_cow_enabled():
+                    # COW: checksums already recorded from the live
+                    # bytes — skip the clone and verify at write time.
+                    self.cow_pending = True
+                    return mv
                 from .. import _native
 
                 out = _acquire_clone_buffer(mv.nbytes)
@@ -199,6 +213,18 @@ class ArrayBufferStager(BufferStager):
             # clone is the async take's blocked time. In deferred mode
             # the clone is a plain memcpy and hashing happens on the
             # write path (late_checksum).
+            from ..knobs import is_async_cow_enabled
+
+            if want_crc and is_async_cow_enabled():
+                # COW (opt-in): no clone at all — record the fused hash
+                # of the LIVE bytes now (overriding deferral: the
+                # stage-time value is the mutation-detection reference)
+                # and have the write pipeline re-verify after the
+                # storage write. Frozen layers pay one read pass and
+                # zero allocation inside the blocked window.
+                _record_checksums(self.entry, mv, self.record_dedup_hashes)
+                self.cow_pending = True
+                return mv
             from .. import _native
 
             out = _acquire_clone_buffer(mv.nbytes)
@@ -274,14 +300,92 @@ class ArrayBufferStager(BufferStager):
             self.record_dedup_hashes,
         )
 
+    def verify_cow_after_write(self, buf) -> None:
+        """COW staging: re-hash the live bytes AFTER the storage write
+        and compare against the checksum recorded inside the blocked
+        window. A mismatch means the caller mutated this array while
+        the async take was in flight — the written blob may hold torn
+        data, so the take fails here (the metadata is never committed)
+        instead of silently snapshotting a state that never existed."""
+        if self.entry is None or self.entry.checksum is None:
+            return
+        from .. import _native
+
+        try:
+            mv = memoryview(buf).cast("B")
+            _native.verify_checksum(mv, self.entry.checksum, self.entry.location)
+            self._verify_cow_xxh_lane(mv)
+        except Exception as e:
+            raise RuntimeError(
+                f"async COW take detected a concurrent mutation of "
+                f"{self.entry.location!r}: the array changed between "
+                "staging and its storage write. Under TPUSNAP_ASYNC_COW "
+                "the live bytes stay aliased until each blob's write "
+                "completes — mutate state only after "
+                "PendingSnapshot.wait_staged()/wait() returns (both are "
+                "COW-aware and block until the writes drain), or unset "
+                "TPUSNAP_ASYNC_COW to restore defensive cloning."
+            ) from e
+
+    def _verify_cow_xxh_lane(self, mv) -> None:
+        """Re-verify the 64-bit XXH64 dedup lane too, when recorded
+        (incremental takes, small eagerly-hashed blobs) — the CRC32C
+        lane alone is 32 bits of mutation evidence; with the dedup lane
+        the pair matches what dedup skips require. Lanes recorded by a
+        different build's algorithm are skipped, mirroring
+        verify_checksum's policy."""
+        entry = self.entry
+        from .. import _native
+
+        dalgo = _native.dedup_hash_algorithm()
+        if entry.dedup_hash is not None:
+            algo, _, val = entry.dedup_hash.partition(":")
+            if algo == dalgo and int(val, 16) != _native.xxh64(mv):
+                raise _native.ChecksumError(
+                    f"XXH64 lane mismatch for {entry.location!r}"
+                )
+            return
+        if not entry.tile_dedup_hashes:
+            return
+        tile_rows, row_nbytes = _tile_geometry(entry, mv.nbytes)
+        if not tile_rows:
+            return
+        tile_nbytes = tile_rows * row_nbytes
+        for i, recorded in enumerate(entry.tile_dedup_hashes):
+            algo, _, val = recorded.partition(":")
+            if algo != dalgo:
+                return
+            tile = mv[i * tile_nbytes : (i + 1) * tile_nbytes]
+            if int(val, 16) != _native.xxh64(tile):
+                raise _native.ChecksumError(
+                    f"XXH64 tile {i} mismatch for {entry.location!r}"
+                )
+
     def get_staging_cost_bytes(self) -> int:
+        n = self.get_planned_bytes()
+        if self.is_async_snapshot:
+            from ..knobs import is_async_cow_enabled, is_checksum_disabled
+
+            if (
+                is_async_cow_enabled()
+                and self.entry is not None
+                and not is_checksum_disabled()
+            ):
+                # COW staging (same conditions as _stage_blocking's COW
+                # branches): no second host copy is ever held — the
+                # live bytes are written directly and verified by hash.
+                return n
+            # Defensive clone: a second host copy while in flight.
+            return 2 * n
+        return n
+
+    def get_planned_bytes(self) -> int:
+        """Payload bytes (the progress denominator) — never doubled by
+        the async clone's staging-cost accounting."""
         if self.array_prepare_func is not None and self.entry is not None:
             # What will actually be staged is the transformed array.
-            n = tensor_nbytes(self.entry.dtype, self.entry.shape)
-        else:
-            n = array_nbytes(self.arr)
-        # async snapshots hold a second host copy while in flight
-        return 2 * n if self.is_async_snapshot else n
+            return tensor_nbytes(self.entry.dtype, self.entry.shape)
+        return array_nbytes(self.arr)
 
 
 # platform name -> does np.asarray of a device array ALIAS the XLA

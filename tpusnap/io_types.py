@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import contextlib as _contextlib
 import io
+import threading as _threading
 from concurrent.futures import Executor
 from dataclasses import dataclass, field
 from typing import Generic, List, Optional, Tuple, TypeVar, Union
@@ -88,6 +90,14 @@ class BufferStager(abc.ABC):
     @abc.abstractmethod
     def get_staging_cost_bytes(self) -> int:
         """Peak host memory consumed while this buffer is staged."""
+
+    def get_planned_bytes(self) -> int:
+        """Payload bytes this request will actually stage/write — the
+        progress denominator. Defaults to the staging cost; stagers
+        whose cost model charges MORE than the payload (async array
+        clones hold a second host copy, so their cost is 2x) override
+        this so heartbeat percentages can reach 100."""
+        return self.get_staging_cost_bytes()
 
 
 @dataclass
@@ -280,6 +290,54 @@ class StoragePlugin(abc.ABC):
         self, event_loop: Optional[asyncio.AbstractEventLoop] = None
     ) -> None:
         _run(self.close(), event_loop)
+
+
+# --- finalizer-safe close -------------------------------------------------
+#
+# Joining a thread from a GC finalizer can deadlock the process: if the
+# collection that runs ``Snapshot.__del__`` fires inside a STARTING
+# thread's ``Thread._set_tstate_lock`` (which holds
+# ``threading._shutdown_locks_lock``), the join's ``Thread._stop``
+# re-acquires that same lock and the thread waits on itself forever
+# (observed killing a tier-1 run). Explicit closes KEEP joining — the
+# take-abort path relies on close as its quiescence point for in-flight
+# I/O threads (a straggler write surviving close could recreate a
+# just-deleted blob of an aborted take). Only the finalizer path opts
+# out, via this thread-local guard consulted by the executor-owning
+# plugins' ``close()``.
+
+_finalizer_close = _threading.local()
+
+
+@_contextlib.contextmanager
+def finalizer_close_scope():
+    """Mark plugin ``close()`` calls on this thread as GC-finalizer
+    driven: executor shutdowns skip their thread joins (queued work
+    still runs; the interpreter joins workers at exit)."""
+    # Save/restore (not set/clear): a nested finalizer — close()
+    # dropping the last reference to another Snapshot — must not
+    # re-enable joins for the OUTER finalizer still unwinding.
+    prior = getattr(_finalizer_close, "active", False)
+    _finalizer_close.active = True
+    try:
+        yield
+    finally:
+        _finalizer_close.active = prior
+
+
+def close_may_join() -> bool:
+    """Whether a plugin ``close()`` may join threads (False only inside
+    :func:`finalizer_close_scope`)."""
+    return not getattr(_finalizer_close, "active", False)
+
+
+def shutdown_plugin_executor(executor) -> None:
+    """The one place the join-on-close policy lives: explicit closes
+    JOIN (abort-path quiescence — a straggler write thread surviving
+    close could recreate a just-deleted blob of an aborted take);
+    GC-finalizer closes must NOT (see the deadlock note above).
+    Executor-owning plugins call this from ``close()``."""
+    executor.shutdown(wait=close_may_join())
 
 
 def run_on_loop(event_loop: asyncio.AbstractEventLoop, coro):

@@ -38,6 +38,9 @@ _METRICS_EXPORT_ENV_VAR = "TPUSNAP_METRICS_EXPORT"
 _METRICS_DIR_ENV_VAR = "TPUSNAP_METRICS_DIR"
 _HISTORY_ENV_VAR = "TPUSNAP_HISTORY"
 _HISTORY_MAX_BYTES_ENV_VAR = "TPUSNAP_HISTORY_MAX_BYTES"
+_STAGE_THREADS_ENV_VAR = "TPUSNAP_STAGE_THREADS"
+_ASYNC_STAGE_WINDOW_ENV_VAR = "TPUSNAP_ASYNC_STAGE_WINDOW_BYTES"
+_ASYNC_COW_ENV_VAR = "TPUSNAP_ASYNC_COW"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -49,6 +52,13 @@ _DEFAULT_DIRECT_IO_CHUNK_BYTES = 32 * 1024 * 1024
 # Row-tile granularity for tile-grain checksums on large dense blobs
 # (the verifiable unit of memory-budgeted partial reads).
 _DEFAULT_TILE_CHECKSUM_BYTES = 16 * 1024 * 1024
+# Staging window of a pipelined async take: the blocked window stages at
+# most this much staging COST before control returns to training, and it
+# is the effective in-flight staging budget of the background drain —
+# so blocked time and clone RSS are both O(window), not O(state). Two
+# max-size chunks (2 x 512 MB, cost 2x while the clone is held) fit, so
+# the drain overlaps clone(N+1) with write(N) instead of serializing.
+_DEFAULT_ASYNC_STAGE_WINDOW_BYTES = 2 * 1024 * 1024 * 1024
 
 
 def _get_float_env(name: str, default: float) -> float:
@@ -293,6 +303,50 @@ def get_history_max_bytes() -> int:
     )
 
 
+def get_stage_threads() -> int:
+    """Worker threads of the write scheduler's staging executor (the
+    clone / DtoH / serialize pass). Default 1: staging is
+    memory-bandwidth work with the GIL released, and interleaved clone
+    threads were measured SLOWER in aggregate than one (~1 GB/s for 4
+    threads vs ~4 GB/s for one on the dev host — cache-line ping-pong
+    plus context switching). Raise on hosts whose memory system feeds
+    multiple cores (real TPU-VMs: 2-4) after measuring; clamped to
+    [1, 16]."""
+    return max(1, min(16, _get_int_env(_STAGE_THREADS_ENV_VAR, 1)))
+
+
+def get_async_stage_window_bytes() -> Optional[int]:
+    """Staging window of a pipelined async take (see
+    :mod:`tpusnap.scheduler`): ``async_take`` returns control once the
+    first window of write requests is staged, and the background drain
+    stages subsequent windows interleaved with storage I/O under this
+    in-flight bound — blocked time and clone RSS are O(window) instead
+    of O(state). ``0`` disables pipelining: ``async_take`` then stages
+    the WHOLE state before returning (the pre-pipeline strict
+    semantics, for callers that mutate host-aliasing state in place
+    immediately after control returns instead of using
+    ``PendingSnapshot.wait_staged()``)."""
+    val = _get_int_env(
+        _ASYNC_STAGE_WINDOW_ENV_VAR, _DEFAULT_ASYNC_STAGE_WINDOW_BYTES
+    )
+    return val if val > 0 else None
+
+
+def is_async_cow_enabled() -> bool:
+    """Copy-on-write async staging for host-aliasing arrays (numpy /
+    pinned_host / CPU-backend device arrays), OPT-IN: instead of the
+    defensive clone, the blocked window records the fused
+    CRC32C(+XXH64) hash of the live bytes and the write path re-hashes
+    after the storage write — a mismatch (the caller mutated the array
+    mid-take) fails the take loudly instead of committing torn data.
+    Frozen layers (the common case for the biggest arrays) then clone
+    NOTHING: the blocked window pays one read pass, no allocation, no
+    copy. Off by default because it weakens the defensive-clone
+    guarantee from "mutation cannot corrupt" to "mutation is detected
+    and fails the take"."""
+    return os.environ.get(_ASYNC_COW_ENV_VAR, "0") == "1"
+
+
 def get_memory_budget_override_bytes() -> Optional[int]:
     if _MEMORY_BUDGET_ENV_VAR not in os.environ:
         return None
@@ -441,4 +495,23 @@ def override_history_enabled(enabled: bool) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_history_max_bytes(nbytes: int) -> Generator[None, None, None]:
     with _override_env(_HISTORY_MAX_BYTES_ENV_VAR, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_stage_threads(n: int) -> Generator[None, None, None]:
+    with _override_env(_STAGE_THREADS_ENV_VAR, str(n)):
+        yield
+
+
+@contextlib.contextmanager
+def override_async_stage_window_bytes(nbytes: int) -> Generator[None, None, None]:
+    """0 disables pipelined async staging (strict stage-all semantics)."""
+    with _override_env(_ASYNC_STAGE_WINDOW_ENV_VAR, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_async_cow(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(_ASYNC_COW_ENV_VAR, "1" if enabled else "0"):
         yield

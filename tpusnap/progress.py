@@ -335,10 +335,16 @@ class ProgressMonitor:
         planned = self._bytes_planned
         if self._state == "committed":
             percent: Optional[float] = 100.0
+            staged_percent: Optional[float] = 100.0
         elif planned > 0:
             percent = round(min(100.0, 100.0 * written / planned), 1)
+            # Pipelined async takes stage residual windows on the
+            # background drain — surface that leg's own progress so a
+            # watcher can tell "still cloning" from "still writing".
+            staged_percent = round(min(100.0, 100.0 * staged / planned), 1)
         else:
             percent = None
+            staged_percent = None
         prev_t, prev_b = self._last_rate_point
         if now - prev_t >= self.interval_s:
             self._mbps = round((written - prev_b) / max(now - prev_t, 1e-9) / 1e6, 1)
@@ -356,6 +362,7 @@ class ProgressMonitor:
             "bytes_planned": planned,
             "bytes_written": written,
             "bytes_staged": staged,
+            "staged_percent": staged_percent,
             "percent": percent,
             "mbps": self._mbps,
             "beat_age_s": round(now - self._last_advance, 2),

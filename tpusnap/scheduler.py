@@ -9,18 +9,29 @@ Semantics preserved:
   numpy/ctypes/XLA) is dispatched only while the outstanding staging cost
   fits the memory budget — but at least one request is always allowed so a
   single over-budget item can't deadlock (scheduler.py:264-275). Storage
-  I/O keeps ≤16 requests in flight; staging uses ≤4 threads.
-- ``execute_write_reqs`` returns once **staging** completes — the snapshot
-  is then consistent (buffers no longer alias live arrays) and residual
-  storage I/O is handed back as ``PendingIOWork`` (scheduler.py:178-217),
-  which ``take`` drains synchronously and ``async_take`` drains in a
-  background thread.
+  I/O keeps ≤16 requests in flight; the staging executor is sized by
+  TPUSNAP_STAGE_THREADS (default 1 — interleaved clone threads measured
+  SLOWER in aggregate than one on this memory system).
+- ``execute_write_reqs`` returns a ``PendingIOWork`` once the take's
+  BLOCKED WINDOW closes. For sync takes and staging-priority async takes
+  that is staging-complete (the snapshot is then consistent: buffers no
+  longer alias live arrays). For PIPELINED async takes
+  (``pipelined_staging=True``) it is first-window-staged: only a
+  memory-budget-bounded window of write requests is staged before control
+  returns, and the background drain keeps cloning window after window,
+  releasing each to storage I/O — blocked time and clone RSS are
+  O(window), not O(state). The engine itself is resumable
+  (:class:`_WriteScheduler`): the same stage ∥ write loop runs to the
+  blocked-window boundary on the caller's thread and to completion inside
+  ``PendingIOWork`` (``take`` drains synchronously, ``async_take`` on a
+  background thread).
 - Read path mirrors it (scheduler.py:357-444): read (≤16 concurrent,
   budget-gated on consuming cost) ∥ consume (deserialize + copy into the
   restore target, thread pool).
 - Memory budget = min(0.6 × available host RAM / local_world_size, 32GB),
   env-overridable; local world size discovered by all-gathering hostnames
-  (scheduler.py:27-65).
+  (scheduler.py:27-65). Pipelined async takes further clamp their
+  in-flight staging budget to TPUSNAP_ASYNC_STAGE_WINDOW_BYTES.
 """
 
 from __future__ import annotations
@@ -28,11 +39,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import socket
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field
-from typing import List, Optional, Set
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Set
 
 import psutil
 
@@ -126,10 +138,19 @@ class _Reporter:
         self.stage_counts: dict = {}
         self.budget_remaining: Optional[int] = None
         self.total_budget: Optional[int] = None
+        # Pipelined async takes: wall-clock of the blocked window (first
+        # window staged, control returned) and how many staging windows
+        # the take ran in total.
+        self.blocked_done_ts: Optional[float] = None
+        self.stage_windows: Optional[int] = None
 
     def mark_staging_complete(self) -> None:
         if self.staging_done_ts is None:
             self.staging_done_ts = time.monotonic()
+
+    def mark_blocked_window_done(self) -> None:
+        if self.blocked_done_ts is None:
+            self.blocked_done_ts = time.monotonic()
 
     def report_request_done(self, nbytes: int) -> None:
         self.reqs_done += 1
@@ -179,6 +200,10 @@ class _Reporter:
             "throughput_mbps": self.bytes_done / 1e6 / elapsed,
             "budget_bytes": self.total_budget,
         }
+        if self.blocked_done_ts is not None:
+            stats["blocked_s"] = max(self.blocked_done_ts - self.begin_ts, 0.0)
+        if self.stage_windows is not None:
+            stats["stage_windows"] = self.stage_windows
         LAST_EXECUTION_STATS[self.verb] = stats
         if staging_elapsed is not None:
             # The number async_take exists to minimize: training is blocked
@@ -209,40 +234,34 @@ class _Reporter:
 
 @dataclass
 class PendingIOWork:
-    """Residual storage I/O after staging completed (reference
-    scheduler.py:178-217). Keeps honoring the I/O concurrency cap while
-    draining."""
+    """Work remaining after the blocked window closed (reference
+    scheduler.py:178-217). ``complete`` resumes the same stage ∥ write
+    engine: residual STAGING windows of a pipelined async take first
+    (interleaved with their storage I/O), then the I/O drain — honoring
+    the same budget and concurrency caps throughout."""
 
-    io_tasks: Set[asyncio.Task] = field(default_factory=set)
-    pending_pipelines: List["_WritePipeline"] = field(default_factory=list)
-    executor: Optional[ThreadPoolExecutor] = None
-    hash_executor: Optional[ThreadPoolExecutor] = None
-    reporter: Optional[_Reporter] = None
+    scheduler: "_WriteScheduler"
+
+    def staging_complete(self) -> bool:
+        """Whether ALL staging is done (buffers no longer alias live
+        arrays). True at construction except for pipelined async takes,
+        whose residual windows stage inside ``complete``."""
+        return self.scheduler.staging_complete
+
+    def wait_staged(self, timeout: Optional[float] = None) -> bool:
+        return self.scheduler.staging_done_event.wait(timeout)
+
+    def drained(self) -> bool:
+        """Whether THIS RANK's write drain (all writes + COW verifies)
+        finished — under COW this, not staging-complete, is when live
+        bytes stop being read."""
+        return self.scheduler.drained_event.is_set()
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        return self.scheduler.drained_event.wait(timeout)
 
     async def complete(self) -> None:
-        io_tasks = set(self.io_tasks)
-        try:
-            pending = list(self.pending_pipelines)
-            while io_tasks or pending:
-                while pending and len(io_tasks) < _MAX_IO_CONCURRENCY:
-                    io_tasks.add(asyncio.ensure_future(pending.pop(0).write()))
-                done, io_tasks = await asyncio.wait(
-                    io_tasks, return_when=asyncio.FIRST_COMPLETED
-                )
-                for task in done:
-                    pipeline = task.result()
-                    if self.reporter is not None:
-                        self.reporter.report_request_done(pipeline.buf_size)
-        except BaseException:
-            await _cancel_and_drain(io_tasks)
-            raise
-        finally:
-            if self.executor is not None:
-                self.executor.shutdown(wait=True)
-            if self.hash_executor is not None:
-                self.hash_executor.shutdown(wait=True)
-        if self.reporter is not None:
-            self.reporter.summarize()
+        await self.scheduler.drain()
 
     def sync_complete(self, event_loop: asyncio.AbstractEventLoop) -> None:
         # run_on_loop: the commit path reuses this loop for the metadata
@@ -352,6 +371,27 @@ class _WritePipeline:
             )
         telemetry.incr("storage.bytes_written", self.buf_size, rec=self.tele)
         telemetry.incr("storage.writes", rec=self.tele)
+        if getattr(stager, "cow_pending", False):
+            # Copy-on-write staging (TPUSNAP_ASYNC_COW): the buffer just
+            # written IS the live array — re-hash it and compare with
+            # the checksum recorded inside the blocked window. A
+            # mismatch means the caller mutated the array mid-take; the
+            # take must fail loudly rather than commit torn bytes.
+            cow_start = self.tele.now() if self.tele is not None else 0.0
+            loop = asyncio.get_running_loop()
+            if self.hash_executor is not None:
+                await loop.run_in_executor(
+                    self.hash_executor, stager.verify_cow_after_write, self.buf
+                )
+            else:
+                stager.verify_cow_after_write(self.buf)
+            if self.tele is not None:
+                self.tele.record_span(
+                    "cow_verify",
+                    cow_start,
+                    self.tele.now() - cow_start,
+                    bytes=self.buf_size,
+                )
         # Async-clone buffers go back to the staging pool (warm pages
         # for the next clone of this size); other buffers are ignored by
         # release(). The pool is bounded by TPUSNAP_STAGING_POOL_BYTES,
@@ -363,72 +403,160 @@ class _WritePipeline:
         return self
 
 
-async def execute_write_reqs(
-    write_reqs: List[WriteReq],
-    storage: StoragePlugin,
-    memory_budget_bytes: int,
-    rank: int,
-    prioritize_staging: bool = False,
-) -> PendingIOWork:
-    """``prioritize_staging`` (async takes): do not dispatch storage
-    I/O while staging can still proceed — the blocked window an
-    async_take exists to minimize ends at staging-complete, and on
-    CPU-limited hosts concurrent write-path work (checksums, bounce
-    copies, syscalls) steals core time from the staging pass and
-    stretches that window several-fold (measured 2.8s vs a 0.5s pure
-    clone pass on the 1-core dev host). Writes then drain in the
-    background via PendingIOWork, exactly like orbax's async save
-    defers its serialization+write behind the returned future. I/O IS
-    dispatched mid-staging when staging is budget-starved (writes must
-    complete to free budget — same deadlock-freedom as before). Sync
-    takes keep full overlap: their metric is total time, and disk DMA
-    waits overlap staging profitably even on one core."""
-    executor = ThreadPoolExecutor(
-        max_workers=_MAX_CPU_CONCURRENCY, thread_name_prefix="tpusnap-stage"
-    )
-    # Deferred write-path hashing gets its own pool so it can never
-    # queue ahead of staging tasks (see _WritePipeline.hash_executor).
-    hash_executor = ThreadPoolExecutor(
-        max_workers=_MAX_CPU_CONCURRENCY, thread_name_prefix="tpusnap-hash"
-    )
-    reporter = _Reporter(rank=rank, verb="write", total_reqs=len(write_reqs))
-    # Captured once: the drain (PendingIOWork) and late hashing may run
-    # on a background thread after a newer take replaced the ambient
-    # recorder.
-    tele = telemetry.current()
-    stage_phase_start = tele.now() if tele is not None else 0.0
-    # Stage large requests first: they occupy budget longest and their I/O
-    # overlaps with the staging of everything behind them.
-    pipelines = deque(
-        sorted(
-            (
-                _WritePipeline(wr, storage, executor, hash_executor, tele)
-                for wr in write_reqs
-            ),
-            key=lambda p: p.staging_cost,
-            reverse=True,
-        )
-    )
-    # The budget governs IN-FLIGHT staging buffers: every dispatch
-    # debits staging_cost, every write completion credits buf_size —
-    # unconditionally. Buffers the staging pool retains after a write
-    # are NOT withheld from the credit (ADVICE r4: withholding
-    # re-debited the same resident bytes every reuse cycle, and a
-    # budget-capped take whose cumulative clone bytes exceeded the
-    # budget degraded to fully serialized stage-then-write) — the
-    # pool is its own separately bounded cache: worst-case resident is
-    # budget + TPUSNAP_STAGING_POOL_BYTES, and in practice ≈ budget,
-    # because acquire() reuses parked buffers of recurring sizes
-    # (uniform chunk sizes within a take, identical shapes across a
-    # checkpoint loop's takes).
-    budget = memory_budget_bytes
-    staging_tasks: Set[asyncio.Task] = set()
-    io_tasks: Set[asyncio.Task] = set()
+class _WriteScheduler:
+    """Resumable budget-gated stage ∥ write engine behind every take.
 
-    def dispatch_staging() -> None:
-        nonlocal budget
-        while pipelines and len(staging_tasks) < _MAX_CPU_CONCURRENCY:
-            head = pipelines[0]
+    One instance owns the whole pipeline state (request queue, in-flight
+    staging/IO task sets, budget). ``run_blocked_window`` advances it to
+    the take's blocked-window boundary on the calling thread;
+    ``drain`` (via :class:`PendingIOWork`) resumes the SAME loop — on
+    the same event loop, possibly from a background thread — until every
+    request is staged AND written. Three modes:
+
+    - default (sync takes): blocked window = staging complete, staging
+      and storage I/O fully overlapped throughout (the metric is total
+      time; disk DMA waits overlap staging profitably even on one core).
+    - ``prioritize_staging`` (incremental async takes, whose dedup
+      decisions must be final before the manifest gather): blocked
+      window = staging complete, and NO storage I/O is dispatched while
+      staging can still proceed — concurrent write-path work (checksums,
+      bounce copies, syscalls) steals core time from the staging pass
+      and stretches the blocked window several-fold (measured 2.8s vs a
+      0.5s pure clone pass on the 1-core dev host). I/O IS dispatched
+      mid-staging when staging is budget-starved (writes must complete
+      to free budget — deadlock freedom).
+    - ``pipelined_staging`` (async takes): the in-flight staging budget
+      is clamped to TPUSNAP_ASYNC_STAGE_WINDOW_BYTES and the blocked
+      window ends at FIRST-WINDOW-STAGED — the engine has staged one
+      window's worth of requests and proven the pipeline flows; the
+      drain then clones window N+1 while window N's writes release
+      buffers (and budget) back, so blocked time and clone RSS are both
+      O(window) instead of O(state). ``stage_eagerly`` selects requests
+      that must still stage INSIDE the blocked window (multi-process
+      takes: stagers that annotate manifest entries at stage time, whose
+      values would otherwise miss the by-value manifest gather). The I/O
+      gate stays shut during the blocked window exactly as in
+      prioritize mode, and opens permanently once control returns.
+    """
+
+    def __init__(
+        self,
+        write_reqs: List[WriteReq],
+        storage: StoragePlugin,
+        memory_budget_bytes: int,
+        rank: int,
+        prioritize_staging: bool = False,
+        pipelined_staging: bool = False,
+        stage_eagerly: Optional[Callable[[WriteReq], bool]] = None,
+        tele: Optional[telemetry.TakeTelemetry] = None,
+    ) -> None:
+        from .knobs import get_async_stage_window_bytes, get_stage_threads
+
+        self.storage = storage
+        self.rank = rank
+        self.prioritize_staging = prioritize_staging
+        self.pipelined = pipelined_staging
+        self.tele = tele
+        # TPUSNAP_STAGE_THREADS sizes BOTH the executor and the dispatch
+        # cap: staging threads do memory-bandwidth work (memcpy, CRC,
+        # deserialize) with the GIL released, and more threads than the
+        # memory system feeds only adds cache ping-pong (measured on the
+        # 1-vCPU dev host: 4 interleaved clone threads ran ~1 GB/s
+        # aggregate vs ~4 GB/s for one).
+        self.stage_concurrency = get_stage_threads()
+        self.executor = ThreadPoolExecutor(
+            max_workers=self.stage_concurrency,
+            thread_name_prefix="tpusnap-stage",
+        )
+        # Deferred write-path hashing gets its own pool so it can never
+        # queue ahead of staging tasks (see _WritePipeline.hash_executor).
+        self.hash_executor = ThreadPoolExecutor(
+            max_workers=_MAX_CPU_CONCURRENCY, thread_name_prefix="tpusnap-hash"
+        )
+        self.reporter = _Reporter(
+            rank=rank, verb="write", total_reqs=len(write_reqs)
+        )
+        pls = [
+            _WritePipeline(wr, storage, self.executor, self.hash_executor, tele)
+            for wr in write_reqs
+        ]
+        cost_key = lambda p: p.staging_cost  # noqa: E731
+        if self.pipelined and stage_eagerly is not None:
+            # Eager requests lead the queue: they must be staged before
+            # the blocked window may close. Within each group, large
+            # first — they occupy budget longest and their I/O overlaps
+            # the staging of everything behind them.
+            eager = sorted(
+                (p for p in pls if stage_eagerly(p.write_req)),
+                key=cost_key,
+                reverse=True,
+            )
+            rest = sorted(
+                (p for p in pls if not stage_eagerly(p.write_req)),
+                key=cost_key,
+                reverse=True,
+            )
+            self.pipelines = deque(eager + rest)
+            # Identity set, not a count: with TPUSNAP_STAGE_THREADS >= 2
+            # an interleaved NON-eager stager can complete first, and a
+            # bare countdown would let the blocked window close while an
+            # eager (manifest-annotating) stager is still in flight.
+            self.eager_pending = {id(p) for p in eager}
+        else:
+            self.pipelines = deque(sorted(pls, key=cost_key, reverse=True))
+            self.eager_pending = set()
+        total_cost = sum(p.staging_cost for p in pls)
+        if self.pipelined:
+            window = get_async_stage_window_bytes()
+            if window is not None:
+                # The window IS the effective in-flight staging budget:
+                # resident clone bytes never exceed it (plus the ≥1
+                # over-budget admission), whatever the host-RAM budget
+                # would allow.
+                memory_budget_bytes = min(memory_budget_bytes, window)
+        # The budget governs IN-FLIGHT staging buffers: every dispatch
+        # debits staging_cost, every write completion credits buf_size —
+        # unconditionally. Buffers the staging pool retains after a
+        # write are NOT withheld from the credit (ADVICE r4: withholding
+        # re-debited the same resident bytes every reuse cycle, and a
+        # budget-capped take whose cumulative clone bytes exceeded the
+        # budget degraded to fully serialized stage-then-write) — the
+        # pool is its own separately bounded cache: worst-case resident
+        # is budget + TPUSNAP_STAGING_POOL_BYTES, and in practice ≈
+        # budget, because acquire() reuses parked buffers of recurring
+        # sizes (uniform chunk sizes within a take — which is also what
+        # lets window N+1's clones recycle window N's released buffers
+        # so steady-state windows allocate nothing).
+        self.memory_budget_bytes = memory_budget_bytes
+        self.budget = memory_budget_bytes
+        self.reporter.total_budget = memory_budget_bytes
+        # First-window target: the blocked window stages at least this
+        # much staging cost (everything, when the state fits the window).
+        self.first_window_target = min(memory_budget_bytes, total_cost)
+        self.staging_tasks: Set[asyncio.Task] = set()
+        self.io_tasks: Set[asyncio.Task] = set()
+        self.ready_for_io: List[_WritePipeline] = []
+        self.staged_cost_total = 0
+        # I/O gate state for pipelined mode: shut during the blocked
+        # window, open forever after.
+        self.blocked = self.pipelined
+        self.staging_complete = False
+        self.staging_done_event = threading.Event()
+        # Set when THIS RANK's write drain (all writes + COW verifies)
+        # finishes — the COW-mode safe-to-mutate boundary, strictly
+        # earlier than the cross-rank commit barrier.
+        self.drained_event = threading.Event()
+        self._stall_start: Optional[float] = None
+        self._stage_phase_start = tele.now() if tele is not None else 0.0
+        self._window_index = 0
+        self._window_start = self._stage_phase_start
+        self._window_accum = 0
+
+    # --- dispatch ------------------------------------------------------
+
+    def _dispatch_staging(self) -> None:
+        while self.pipelines and len(self.staging_tasks) < self.stage_concurrency:
+            head = self.pipelines[0]
             # The ≥1 over-budget admission may only fire when NOTHING
             # can free budget: staged buffers waiting in ready_for_io
             # count in EVERY mode — they hold budget that the write
@@ -437,128 +565,269 @@ async def execute_write_reqs(
             # resident at once (observed as peak 3/2 budget whenever all
             # in-flight stagings completed in one wait batch before any
             # I/O was dispatched) and unenforced the budget entirely.
-            in_flight = staging_tasks or io_tasks or ready_for_io
-            if head.staging_cost > budget and in_flight:
+            in_flight = self.staging_tasks or self.io_tasks or self.ready_for_io
+            if head.staging_cost > self.budget and in_flight:
                 break  # wait for memory to free up
-            pipelines.popleft()
-            budget -= head.staging_cost
-            if tele is not None:
+            self.pipelines.popleft()
+            self.budget -= head.staging_cost
+            if self.tele is not None:
                 # High-water mark of budget in use (can exceed the
                 # budget via the ≥1 over-budget admission).
-                tele.gauge_max(
-                    "scheduler.budget_used_bytes", memory_budget_bytes - budget
+                self.tele.gauge_max(
+                    "scheduler.budget_used_bytes",
+                    self.memory_budget_bytes - self.budget,
                 )
-            staging_tasks.add(asyncio.ensure_future(head.stage(executor)))
+            self.staging_tasks.add(
+                asyncio.ensure_future(head.stage(self.executor))
+            )
 
-    def staging_budget_starved() -> bool:
+    def _staging_budget_starved(self) -> bool:
         return (
-            bool(pipelines)
-            and len(staging_tasks) < _MAX_CPU_CONCURRENCY
-            and pipelines[0].staging_cost > budget
+            bool(self.pipelines)
+            and len(self.staging_tasks) < self.stage_concurrency
+            and self.pipelines[0].staging_cost > self.budget
         )
 
-    def io_gate_open() -> bool:
-        if not prioritize_staging:
+    def _io_gate_open(self) -> bool:
+        if self.staging_complete:
+            return True  # nothing left to prioritize; drain freely
+        if self.pipelined:
+            if not self.blocked:
+                return True
+        elif not self.prioritize_staging:
             return True
-        # Open ONLY while staging is budget-starved (requests pending
-        # but none runnable): write completions are the only budget
-        # source. Everything else drains via PendingIOWork after the
-        # blocked window closes.
-        return bool(pipelines and not staging_tasks)
+        # Blocked window (pipelined) / staging-priority mode: open ONLY
+        # while staging is budget-starved (requests pending but none
+        # runnable) — write completions are the only budget source.
+        return bool(self.pipelines and not self.staging_tasks)
 
-    def dispatch_io(ready: List[_WritePipeline]) -> None:
-        if not io_gate_open():
+    def _dispatch_io(self) -> None:
+        if not self._io_gate_open():
             return
-        while ready and len(io_tasks) < _MAX_IO_CONCURRENCY:
-            io_tasks.add(asyncio.ensure_future(ready.pop(0).write()))
+        while self.ready_for_io and len(self.io_tasks) < _MAX_IO_CONCURRENCY:
+            self.io_tasks.add(
+                asyncio.ensure_future(self.ready_for_io.pop(0).write())
+            )
 
-    ready_for_io: List[_WritePipeline] = []
-    reporter.total_budget = memory_budget_bytes
-
-    def update_reporter_state() -> None:
-        reporter.stage_counts = {
-            "ready_for_staging": len(pipelines),
-            "staging": len(staging_tasks),
-            "ready_for_io": len(ready_for_io),
-            "io": len(io_tasks),
+    def _update_reporter(self) -> None:
+        self.reporter.stage_counts = {
+            "ready_for_staging": len(self.pipelines),
+            "staging": len(self.staging_tasks),
+            "ready_for_io": len(self.ready_for_io),
+            "io": len(self.io_tasks),
         }
-        reporter.budget_remaining = budget
+        self.reporter.budget_remaining = self.budget
 
-    stall_start: Optional[float] = None
-    try:
-        dispatch_staging()
-        while staging_tasks or pipelines:
-            # Budget-stall EPISODES, not wait iterations: one span +
-            # counter per contiguous window in which the head request
-            # cannot be admitted, however many task completions the
-            # window spans.
-            if staging_budget_starved():
-                if stall_start is None:
-                    stall_start = tele.now() if tele is not None else 0.0
-                    telemetry.incr("scheduler.budget_waits", rec=tele)
-            elif stall_start is not None:
-                if tele is not None:
-                    tele.record_span(
-                        "budget_wait", stall_start, tele.now() - stall_start
-                    )
-                stall_start = None
+    # --- window / stall bookkeeping ------------------------------------
+
+    def _note_stall(self) -> None:
+        # Budget-stall EPISODES, not wait iterations: one span + counter
+        # per contiguous window in which the head request cannot be
+        # admitted, however many task completions the window spans.
+        if self._staging_budget_starved():
+            if self._stall_start is None:
+                self._stall_start = (
+                    self.tele.now() if self.tele is not None else 0.0
+                )
+                telemetry.incr("scheduler.budget_waits", rec=self.tele)
+        elif self._stall_start is not None:
+            if self.tele is not None:
+                self.tele.record_span(
+                    "budget_wait",
+                    self._stall_start,
+                    self.tele.now() - self._stall_start,
+                )
+            self._stall_start = None
+
+    def _on_staged(self, pipeline: "_WritePipeline") -> None:
+        self.staged_cost_total += pipeline.staging_cost
+        if not self.pipelined:
+            return
+        self._window_accum += pipeline.staging_cost
+        if self._window_accum >= self.memory_budget_bytes:
+            self._close_window()
+
+    def _close_window(self) -> None:
+        """Record one per-window ``stage_window`` span (the blocked
+        window is window 0 — measurable on its own in the trace)."""
+        if self._window_accum <= 0:
+            return
+        if self.tele is not None:
+            now = self.tele.now()
+            self.tele.record_span(
+                "stage_window",
+                self._window_start,
+                now - self._window_start,
+                window=self._window_index,
+                bytes=self._window_accum,
+            )
+            self._window_start = now
+        self._window_index += 1
+        self._window_accum = 0
+
+    def _first_window_done(self) -> bool:
+        return (
+            not self.eager_pending
+            and self.staged_cost_total >= self.first_window_target
+        )
+
+    def _finish_staging(self) -> None:
+        if self.staging_complete:
+            return
+        self.staging_complete = True
+        self.reporter.mark_staging_complete()
+        if self._stall_start is not None:
+            if self.tele is not None:
+                self.tele.record_span(
+                    "budget_wait",
+                    self._stall_start,
+                    self.tele.now() - self._stall_start,
+                )
+            self._stall_start = None
+        if self.pipelined:
+            self._close_window()
+            self.reporter.stage_windows = max(self._window_index, 1)
+        elif self.tele is not None:
+            # Interior measurement of the staging window (the "stage"
+            # PHASE is recorded by the take around the whole
+            # sync_execute call).
+            self.tele.record_span(
+                "stage_window",
+                self._stage_phase_start,
+                self.tele.now() - self._stage_phase_start,
+                reqs=self.reporter.total_reqs,
+            )
+        self.staging_done_event.set()
+
+    # --- the loop ------------------------------------------------------
+
+    async def _pump(self, stop_at_first_window: bool) -> None:
+        self._dispatch_staging()
+        while self.staging_tasks or self.pipelines:
+            if stop_at_first_window and self._first_window_done():
+                return
+            self._note_stall()
             done, _ = await asyncio.wait(
-                staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
+                self.staging_tasks | self.io_tasks,
+                return_when=asyncio.FIRST_COMPLETED,
             )
             for task in done:
-                if task in staging_tasks:
-                    staging_tasks.discard(task)
+                if task in self.staging_tasks:
+                    self.staging_tasks.discard(task)
                     pipeline = task.result()  # re-raises staging failure
                     # Staged buffer may be smaller than the staging cost
-                    # (e.g. cost model overestimates); credit the difference.
-                    budget += pipeline.staging_cost - pipeline.buf_size
+                    # (e.g. cost model overestimates); credit the
+                    # difference.
+                    self.budget += pipeline.staging_cost - pipeline.buf_size
+                    self.eager_pending.discard(id(pipeline))
                     # Heartbeat feed: bytes past the staging stage (the
                     # window async_take blocks training on).
                     telemetry.incr(
-                        "scheduler.bytes_staged", pipeline.buf_size, rec=tele
+                        "scheduler.bytes_staged",
+                        pipeline.buf_size,
+                        rec=self.tele,
                     )
+                    self._on_staged(pipeline)
                     if pipeline.skipped:
                         # Dedup'd against a previous snapshot: no I/O.
-                        reporter.report_request_done(0)
+                        self.reporter.report_request_done(0)
                     else:
-                        ready_for_io.append(pipeline)
-                elif task in io_tasks:
-                    io_tasks.discard(task)
+                        self.ready_for_io.append(pipeline)
+                elif task in self.io_tasks:
+                    self.io_tasks.discard(task)
                     pipeline = task.result()
-                    budget += pipeline.buf_size
-                    reporter.report_request_done(pipeline.buf_size)
-            # Staging first: the I/O gate (prioritize_staging) must see
-            # the REFILLED staging set, or it opens spuriously in the
-            # instant between one stager finishing and the next starting.
-            dispatch_staging()
-            dispatch_io(ready_for_io)
-            update_reporter_state()
-    except BaseException:
-        await _cancel_and_drain(staging_tasks | io_tasks)
-        executor.shutdown(wait=True)
-        hash_executor.shutdown(wait=True)
-        raise
-    reporter.mark_staging_complete()
-    if tele is not None:
-        # Interior measurement of the staging window (the "stage" PHASE
-        # is recorded by the take around the whole sync_execute call).
-        tele.record_span(
-            "stage_window",
-            stage_phase_start,
-            tele.now() - stage_phase_start,
-            reqs=len(write_reqs),
-        )
+                    self.budget += pipeline.buf_size
+                    self.reporter.report_request_done(pipeline.buf_size)
+            # Staging first: the I/O gate must see the REFILLED staging
+            # set, or it opens spuriously in the instant between one
+            # stager finishing and the next starting.
+            self._dispatch_staging()
+            self._dispatch_io()
+            self._update_reporter()
+        self._finish_staging()
 
-    # Staging complete: snapshot content is now frozen. Remaining I/O is
-    # handed back so the caller decides whether to drain it in the
-    # foreground (take) or a background thread (async_take).
-    return PendingIOWork(
-        io_tasks=io_tasks,
-        pending_pipelines=ready_for_io,
-        executor=executor,
-        hash_executor=hash_executor,
-        reporter=reporter,
+    async def _abort(self) -> None:
+        await _cancel_and_drain(self.staging_tasks | self.io_tasks)
+        self.executor.shutdown(wait=True)
+        self.hash_executor.shutdown(wait=True)
+
+    async def run_blocked_window(self) -> None:
+        """Advance to the blocked-window boundary: staging-complete
+        (sync / staging-priority modes) or first-window-staged
+        (pipelined mode). In-flight tasks stay parked on the event loop
+        for ``drain`` to resume."""
+        try:
+            await self._pump(stop_at_first_window=self.pipelined)
+        except BaseException:
+            await self._abort()
+            raise
+        self.reporter.mark_blocked_window_done()
+        if self.pipelined:
+            self.blocked = False  # I/O gate opens for the drain
+            if self.tele is not None:
+                self.tele.record_span(
+                    "stage_blocked",
+                    self._stage_phase_start,
+                    self.tele.now() - self._stage_phase_start,
+                    reqs=self.reporter.total_reqs,
+                    staged_cost=self.staged_cost_total,
+                )
+
+    async def drain(self) -> None:
+        """Resume to completion: residual staging windows (interleaved
+        with their writes), then the storage I/O drain."""
+        try:
+            await self._pump(stop_at_first_window=False)
+            while self.io_tasks or self.ready_for_io:
+                self._dispatch_io()
+                done, _ = await asyncio.wait(
+                    self.io_tasks, return_when=asyncio.FIRST_COMPLETED
+                )
+                for task in done:
+                    self.io_tasks.discard(task)
+                    pipeline = task.result()
+                    self.budget += pipeline.buf_size
+                    self.reporter.report_request_done(pipeline.buf_size)
+                self._update_reporter()
+        except BaseException:
+            await self._abort()
+            raise
+        finally:
+            self.executor.shutdown(wait=True)
+            self.hash_executor.shutdown(wait=True)
+        self.drained_event.set()
+        self.reporter.summarize()
+
+
+async def execute_write_reqs(
+    write_reqs: List[WriteReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+    prioritize_staging: bool = False,
+    pipelined_staging: bool = False,
+    stage_eagerly: Optional[Callable[[WriteReq], bool]] = None,
+) -> PendingIOWork:
+    """Run the write engine to its blocked-window boundary and hand the
+    rest back as :class:`PendingIOWork` (see :class:`_WriteScheduler`
+    for the three modes). ``take`` drains the returned work in the
+    foreground; ``async_take`` on a background thread."""
+    # Captured once: the drain (PendingIOWork) and late hashing may run
+    # on a background thread after a newer take replaced the ambient
+    # recorder.
+    tele = telemetry.current()
+    sched = _WriteScheduler(
+        write_reqs,
+        storage,
+        memory_budget_bytes,
+        rank,
+        prioritize_staging=prioritize_staging,
+        pipelined_staging=pipelined_staging,
+        stage_eagerly=stage_eagerly,
+        tele=tele,
     )
+    await sched.run_blocked_window()
+    return PendingIOWork(scheduler=sched)
 
 
 def sync_execute_write_reqs(
@@ -568,6 +837,8 @@ def sync_execute_write_reqs(
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
     prioritize_staging: bool = False,
+    pipelined_staging: bool = False,
+    stage_eagerly: Optional[Callable[[WriteReq], bool]] = None,
 ) -> PendingIOWork:
     return run_on_loop(
         event_loop,
@@ -577,6 +848,8 @@ def sync_execute_write_reqs(
             memory_budget_bytes,
             rank,
             prioritize_staging=prioritize_staging,
+            pipelined_staging=pipelined_staging,
+            stage_eagerly=stage_eagerly,
         ),
     )
 

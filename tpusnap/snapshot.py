@@ -12,10 +12,16 @@ Preserved semantics (call stacks in SURVEY.md §3):
   budget-gated pipelined execution → two-phase commit: rank 0 writes
   ``.snapshot_metadata`` only after every rank finished writing
   (reference :227-234).
-- ``async_take``: staging completes before control returns (snapshot is
-  consistent); storage I/O + commit happen on a background thread that
+- ``async_take``: control returns at FIRST-WINDOW-STAGED — a
+  memory-budget-bounded window of write requests is staged on the
+  calling thread (everything, when the state fits
+  TPUSNAP_ASYNC_STAGE_WINDOW_BYTES — then the pre-pipeline
+  staging-complete semantics hold exactly); residual staging windows,
+  storage I/O and the commit happen on a background thread that
   coordinates via a KV-store LinearBarrier — never collectives
-  (reference :856-944).
+  (reference :856-944). ``PendingSnapshot.wait_staged()`` is the
+  staging-complete rendezvous for callers that mutate host-aliasing
+  state in place.
 - ``restore``: per-key global order; per-rank manifest view with
   replicated re-expansion and sharded merge; reads scattered/reassembled
   into the target sharding; RNG state restored last (reference :437-481).
@@ -160,9 +166,15 @@ class Snapshot:
         # Best-effort: `Snapshot(path).restore(...)` temporaries are
         # refcount-collected at statement end, so the common drop-the-
         # handle pattern releases its loop and storage promptly without
-        # an explicit close().
+        # an explicit close(). Finalizer scope: plugin close() must not
+        # join threads here — GC can fire inside a starting thread's
+        # Thread._set_tstate_lock, where a join self-deadlocks on
+        # threading._shutdown_locks_lock (io_types.finalizer_close_scope).
+        from .io_types import finalizer_close_scope
+
         try:
-            self.close()
+            with finalizer_close_scope():
+                self.close()
         except Exception:
             pass
 
@@ -368,8 +380,13 @@ class Snapshot:
                 incremental_from=incremental_from,
                 abort_ctx=abort_ctx,
             )
-            # Control returns to training here: staging is complete, the
-            # snapshot content is frozen; only storage I/O remains.
+            # Control returns to training here: the blocked window is
+            # over — the first staging window is staged (ALL staging,
+            # when the state fits TPUSNAP_ASYNC_STAGE_WINDOW_BYTES or
+            # the take is incremental); residual windows clone on the
+            # background drain, interleaved with storage I/O. Callers
+            # that mutate host-aliasing state IN PLACE synchronize on
+            # wait_staged(); functional JAX updates never need to.
             return PendingSnapshot(
                 path=path,
                 pending_io_work=pending_io_work,
@@ -1139,13 +1156,14 @@ def _take_impl(
         # harmless no-op failure).
         abort_ctx.write_paths = [wr.path for wr in write_reqs]
     if progress_monitor is not None:
-        # Denominator of the heartbeat's byte progress; dedup/salvage
-        # skips make written < planned, so the committed record forces
-        # 100% (the mid-flight figure is best-effort by design).
+        # Denominator of the heartbeat's byte progress — PAYLOAD bytes,
+        # not staging cost (async array clones charge 2x cost; dividing
+        # written/staged bytes by that capped the percentages at ~50).
+        # Dedup/salvage skips make written < planned, so the committed
+        # record forces 100% (the mid-flight figure is best-effort by
+        # design).
         progress_monitor.set_bytes_planned(
-            sum(
-                wr.buffer_stager.get_staging_cost_bytes() for wr in write_reqs
-            )
+            sum(wr.buffer_stager.get_planned_bytes() for wr in write_reqs)
         )
 
     # Non-incremental takes hash on the WRITE path instead of the
@@ -1177,27 +1195,61 @@ def _take_impl(
         comm, local_world_size=local_world_size
     )
     mark("prepare", write_reqs=len(write_reqs))
+    # Async-take scheduling mode. PIPELINED (the default async path):
+    # the blocked window stages only a TPUSNAP_ASYNC_STAGE_WINDOW_BYTES
+    # window of write requests before control returns; the remaining
+    # windows clone on the background drain, interleaved with their
+    # storage I/O — blocked time and clone RSS are O(window), not
+    # O(state). Incremental takes cannot pipeline: their dedup
+    # decisions mutate entry locations at stage time and must be final
+    # before the manifest gather below, so they keep the strict
+    # stage-everything-first mode (their blocked window is inherently
+    # the hash pass). A window of 0 also restores strict semantics.
+    from .knobs import get_async_stage_window_bytes
+
+    pipelined = (
+        is_async_snapshot
+        and incremental_from is None
+        and get_async_stage_window_bytes() is not None
+    )
+    stage_eagerly = None
+    if pipelined and multi:
+        # Multi-process manifests gather BY VALUE right after this call
+        # returns: stagers that annotate entries at stage time (slabs,
+        # objects — everything that does not defer its checksums to the
+        # write path) must stage inside the blocked window or their
+        # values would miss the gathered manifest. Deferring array
+        # stagers transport theirs through _LateChecksums instead.
+        stage_eagerly = lambda wr: not getattr(  # noqa: E731
+            wr.buffer_stager, "defer_checksums", False
+        )
     pending_io_work = sync_execute_write_reqs(
         write_reqs,
         storage,
         memory_budget,
         rank,
         event_loop,
-        # Async takes: training is blocked until staging completes, so
-        # writes wait their turn (they drain in the background via
-        # PendingIOWork) instead of stealing CPU from the staging pass
-        # — see execute_write_reqs.
-        prioritize_staging=is_async_snapshot,
+        # Non-pipelined async takes: training is blocked until staging
+        # completes, so writes wait their turn (they drain in the
+        # background via PendingIOWork) instead of stealing CPU from
+        # the staging pass — see scheduler._WriteScheduler.
+        prioritize_staging=is_async_snapshot and not pipelined,
+        pipelined_staging=pipelined,
+        stage_eagerly=stage_eagerly,
     )
-    # The manifest is gathered AFTER staging completes (sync_execute
-    # returns at staging-complete; storage I/O may still be in flight):
-    # stagers record per-blob checksums into their entries at stage time,
-    # and those must land in the committed metadata. The reference
-    # gathers before scheduling (snapshot.py:842-853) only because its
-    # entries are final at prepare time.
-    # The staging window (the phase async_take blocks training on),
-    # including the scheduler's dispatch/wind-down; the scheduler's own
-    # "stage_window" op span is the interior measurement.
+    # The manifest is gathered once sync_execute returns (storage I/O —
+    # and, for pipelined async takes, residual staging windows — may
+    # still be in flight): stagers whose entry annotations must land in
+    # the gathered manifest have staged by now (everything, for sync and
+    # incremental takes; the eager set above for pipelined multi-process
+    # takes — single-process manifests share the entry OBJECTS, whose
+    # late annotations land before the commit encodes them). The
+    # reference gathers before scheduling (snapshot.py:842-853) only
+    # because its entries are final at prepare time.
+    # The "stage" phase is the window async_take blocks training on
+    # (first-window-staged for pipelined takes, staging-complete
+    # otherwise); the scheduler's "stage_blocked"/"stage_window" op
+    # spans are the interior measurements.
     mark("stage", write_reqs=len(write_reqs))
     global_manifest = _gather_manifest(entries, comm)
     mark("manifest_gather")
@@ -1901,11 +1953,15 @@ class _BackgroundWork:
 class PendingSnapshot(_BackgroundWork):
     """Handle for an in-flight async snapshot (reference snapshot.py:856-944).
 
-    A background thread drains storage I/O, then synchronizes the commit
-    through a KV-store LinearBarrier — NO collectives are allowed off the
-    main thread (reference :902). If any rank fails, the error poisons
-    the barrier, ``.snapshot_metadata`` is never written, and ``wait()``
-    re-raises on every rank.
+    A background thread drains the residual staging windows of a
+    pipelined take (interleaved with their storage I/O — see
+    scheduler._WriteScheduler) and the remaining writes, then
+    synchronizes the commit through a KV-store LinearBarrier — NO
+    collectives are allowed off the main thread (reference :902). If any
+    rank fails, the error poisons the barrier, ``.snapshot_metadata`` is
+    never written, and ``wait()`` re-raises on every rank.
+    ``staged()``/``wait_staged()`` expose the staging-complete boundary
+    (content frozen); ``wait()`` the committed snapshot.
     """
 
     BARRIER_TIMEOUT_SEC = 1800.0  # reference snapshot.py:857
@@ -1935,6 +1991,12 @@ class PendingSnapshot(_BackgroundWork):
         self._abort_ctx = abort_ctx
         self._tele_commit = tele_commit
         self._snapshot: Optional[Snapshot] = None
+        # Captured at take time: under COW the staged() rendezvous must
+        # report the SAFE-TO-MUTATE boundary (writes+verifies drained,
+        # live bytes no longer read), not merely staging-complete.
+        from .knobs import is_async_cow_enabled
+
+        self._cow_rendezvous = is_async_cow_enabled()
 
         # Barrier identity must be agreed on the MAIN thread (this may
         # broadcast); the background thread then only touches the KV store.
@@ -1971,7 +2033,16 @@ class PendingSnapshot(_BackgroundWork):
         # background drain records through captured references + the
         # thread-local overlay in _body.
         if tele_commit is not None and tele_commit.tele is not None:
-            telemetry.release_global(tele_commit.tele)
+            # The blocked window (take start → control returns here):
+            # the one number async_take exists to minimize, recorded
+            # before the background thread starts so the summary/history
+            # field is never mutated concurrently. Regression-gated via
+            # `tpusnap history --check --metric async_blocked_s`.
+            tele = tele_commit.tele
+            blocked_s = tele.now()
+            tele.meta["async_blocked_s"] = round(blocked_s, 6)
+            tele.record_span("async_blocked", 0.0, blocked_s)
+            telemetry.release_global(tele)
         self._start()
 
     def _body(self) -> None:
@@ -2087,6 +2158,52 @@ class PendingSnapshot(_BackgroundWork):
         self._event_loop.close()
         if self._tele_commit is not None and self._tele_commit.tele is not None:
             telemetry.end_take(self._tele_commit.tele)
+
+    def staged(self) -> bool:
+        """Whether the snapshot content is frozen — safe for the caller
+        to mutate host-aliasing state IN PLACE (raw numpy buffers,
+        pinned_host donation). Functional JAX updates never need this —
+        the stagers hold references, and staging a donated-and-deleted
+        device array fails loudly.
+
+        Ordinarily this is staging-complete (no buffer aliases live
+        arrays any more): true at construction for non-pipelined takes;
+        pipelined takes (state larger than
+        TPUSNAP_ASYNC_STAGE_WINDOW_BYTES) stage their residual windows
+        on the background drain. Under TPUSNAP_ASYNC_COW the live bytes
+        stay aliased until each blob's write+verify lands, so this
+        reports THIS RANK's write-drain boundary instead (strictly
+        earlier than the cross-rank commit barrier) — the rendezvous
+        CONTRACT (staged() ⟹ safe to mutate) holds either way."""
+        if self._cow_rendezvous:
+            return self._pending_io_work.drained()
+        return self._pending_io_work.staging_complete()
+
+    def wait_staged(self, timeout: Optional[float] = None) -> bool:
+        """Block until :meth:`staged` is True (or ``timeout`` elapses;
+        returns whether the content froze). Re-raises the background
+        failure if the drain died before staging finished — otherwise a
+        crashed drain would turn this into a silent infinite wait."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            step = 0.05
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return self.staged()
+                step = min(step, remaining)
+            settled = (
+                self._pending_io_work.wait_drained(step)
+                if self._cow_rendezvous
+                else self._pending_io_work.wait_staged(step)
+            )
+            if settled:
+                return True
+            if self.done():
+                self._join_and_reraise()
+                return self.staged()
 
     def wait(self) -> Snapshot:
         self._join_and_reraise()

@@ -80,4 +80,6 @@ class FsspecStoragePlugin(StoragePlugin):
         await loop.run_in_executor(self._executor, self._fs.rm, self._full(path))
 
     async def close(self) -> None:
-        self._executor.shutdown(wait=True)
+        from ..io_types import shutdown_plugin_executor
+
+        shutdown_plugin_executor(self._executor)

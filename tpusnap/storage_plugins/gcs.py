@@ -382,4 +382,6 @@ class GCSStoragePlugin(StoragePlugin):
         await self._with_retry(self._delete_blocking, self._object_name(path))
 
     async def close(self) -> None:
-        self._executor.shutdown(wait=True)
+        from ..io_types import shutdown_plugin_executor
+
+        shutdown_plugin_executor(self._executor)

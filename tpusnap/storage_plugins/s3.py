@@ -159,5 +159,7 @@ class S3StoragePlugin(StoragePlugin):
             self._client = None
             self._client_ctx = None
         if self._executor is not None:
-            self._executor.shutdown(wait=True)
+            from ..io_types import shutdown_plugin_executor
+
+            shutdown_plugin_executor(self._executor)
             self._executor = None
