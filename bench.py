@@ -8,17 +8,26 @@ that 1.438 GB/s.
 
 Besides the headline number the JSON carries a decomposition so the
 result is interpretable on any disk:
-- ``roofline_gbps``: in-harness write roofline — the same 16-file layout
-  written as raw streams through the SAME native write engine (same
-  buffer-alignment class as user state arrays, so the same
-  RWF_DONTCACHE/O_DIRECT routing), same thread pool, zero snapshot
-  machinery on top. It is the fastest this byte layout can move with the
-  take's own engine and durability semantics. ``roofline_fraction``
+- ``roofline_gbps``: since round 7, the best in-take probe ceiling
+  across the full-scale runs (None if every run's probe failed). The
+  16-file in-harness roofline (``measure_roofline``: raw streams
+  through the SAME native write engine, same buffer-alignment class,
+  same thread pool, zero snapshot machinery) still anchors the tight
+  ~2 GB fraction probe below. ``roofline_fraction``
   (take / roofline, median of same-window pairs from the tight ~2 GB
   probe — full-scale pairs span minutes and host contention drifts
   inside them; their fractions are published as a diagnostic list)
   reads directly as pipeline efficiency; ~1.0 means the pipeline adds
   nothing.
+- ``roofline_fraction_fullscale`` (since round 7): from IN-TAKE
+  INTERLEAVED PROBES — TPUSNAP_PROBE pauses the take's own write
+  scheduler once per interval and measures the raw ceiling through the
+  same plugin stack, so the full-scale fraction's two sides share
+  every disk window (the former separate roofline session spanned
+  minutes of drift and scattered 0.206–0.707). Probe time is
+  subtracted from the reported take times
+  (``probe_overhead_s_runs``); ``roofline_runs_gbps`` now carries the
+  per-run probe ceilings.
 - The A100 baseline machine's local NVMe sustains multi-GB/s; this VM's
   virtio disk measures ~1-2 GB/s and swings >2x minute to minute
   (single-stream plain-buffered writes are host-throttled to ~0.2 GB/s),
@@ -389,47 +398,77 @@ def main() -> None:
         restore_verified_fracs = fr["fracs_verified"]
         restore_rooflines_verified = fr["rooflines_verified"]
 
-        # The virtio disk's bandwidth swings >2x on multi-second timescales
-        # (host contention), so roofline and take are sampled INTERLEAVED —
-        # comparing a lucky roofline window against an unlucky take window
-        # would say "pipeline overhead" where there is only disk noise.
+        # Full-scale fractions come from IN-TAKE INTERLEAVED PROBES
+        # (TPUSNAP_PROBE): the take's own write scheduler pauses its I/O
+        # once per interval and measures the raw engine ceiling through
+        # the same plugin stack, seconds (not minutes) from the writes
+        # it judges. This replaces the former separate roofline session
+        # per run — at 20 GB that pair spanned minutes of drifting
+        # virtio bandwidth and scattered the fraction 0.206–0.707
+        # (ROADMAP 5a); the probe and the take now genuinely share
+        # every disk window. Probe cost (~8 probes x PROBE_BYTES) is
+        # subtracted from the reported take time (probe_overhead_s_runs
+        # publishes what was subtracted).
+        from tpusnap.knobs import override_probe
         from tpusnap.rss_profiler import measure_rss_deltas
 
+        probe_interval = max(256 * 1024 * 1024, TOTAL_BYTES // 8)
+        probe_bytes = min(64 * 1024 * 1024, max(8 * 1024 * 1024, probe_interval // 8))
         times = []
         splits = []
         rooflines = []
         take_fracs = []
         take_summaries = []
+        probe_overheads = []
         budget_bytes = None
         for run in range(N_TAKE_RUNS):
-            rl = measure_roofline(bench_root, per_array, N_ARRAYS)
-            rooflines.append(rl)
             tmp = os.path.join(bench_root, f"take{run}")
             app_state = {"model": PytreeState(state)}
             # Drain pending page-cache writeback from earlier iterations so
             # each timed take competes only with its own I/O.
             os.sync()
-            t0 = time.perf_counter()
-            Snapshot.take(os.path.join(tmp, "snap"), app_state)
-            el = time.perf_counter() - t0
+            with override_probe(
+                True, interval_bytes=probe_interval, probe_bytes=probe_bytes
+            ):
+                t0 = time.perf_counter()
+                Snapshot.take(os.path.join(tmp, "snap"), app_state)
+                el_raw = time.perf_counter() - t0
+            summary = _tele.LAST_TAKE_SUMMARY or {}
+            probe_info = summary.get("probe") or {}
+            probe_elapsed = probe_info.get("elapsed_s") or 0.0
+            el = max(el_raw - probe_elapsed, 1e-9)
             times.append(el)
-            # Same-round pair: this take against the roofline sampled
-            # moments before it, so disk-bandwidth swings between
-            # rounds cancel out of the fraction.
-            take_fracs.append((nbytes / el / 1e9) / rl)
+            probe_overheads.append(probe_elapsed)
+            # Runs whose probes failed (the runner stands down after
+            # one failure) contribute None — kept IN the per-run lists
+            # so cold_run_index keeps indexing every *_runs array, but
+            # EXCLUDED from the aggregates (a 0.0 would read as a
+            # catastrophic regression in roofline_gbps/..._fullscale
+            # and the bench history event, when only the probe
+            # hiccuped).
+            ceiling = probe_info.get("write_gbps_p50")
+            rooflines.append(ceiling)
+            # The summary's own fraction: payload throughput over the
+            # non-probe wall against the in-take ceiling.
+            frac = summary.get("roofline_fraction")
+            if frac is None and ceiling:
+                frac = (nbytes / el / 1e9) / ceiling
+            take_fracs.append(frac)
             stats = _sched.LAST_EXECUTION_STATS.get("write", {})
             budget_bytes = stats.get("budget_bytes") or budget_bytes
             splits.append(
                 (stats.get("staging_s"), stats.get("total_s"))
             )
-            take_summaries.append(_tele.LAST_TAKE_SUMMARY)
+            take_summaries.append(summary)
             if run + 1 < N_TAKE_RUNS:
                 shutil.rmtree(tmp, ignore_errors=True)
         best_i = min(range(len(times)), key=times.__getitem__)
         best = times[best_i]
         gbps = nbytes / best / 1e9
         staging_s, sched_total_s = splits[best_i]
-        roofline = max(rooflines)
+        # None (not 0.0) when every run's probe failed: absent beats a
+        # fake regression in the JSON and the history gate.
+        roofline = max((r for r in rooflines if r), default=None)
         # Per-stage telemetry of the BEST take (tpusnap.telemetry): the
         # phase decomposition that makes the headline number diagnosable
         # — where the wall-clock went, not just how long it was.
@@ -726,12 +765,18 @@ def main() -> None:
     def _warm(vals):
         return vals[1:] if len(vals) > 1 else vals
 
+    # Aggregation views of the per-run fraction list: None entries are
+    # failed-probe runs (kept in the *_runs arrays for index alignment
+    # with cold_run_index, excluded from every aggregate).
+    _fracs_valid = [f for f in take_fracs if f is not None]
+    _warm_fracs_valid = [f for f in _warm(take_fracs) if f is not None]
+
     result = {
         "metric": "snapshot_take_local_fs",
         "value": round(gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(gbps / BASELINE_GBPS, 3),
-        "roofline_gbps": round(roofline, 3),
+        "roofline_gbps": round(roofline, 3) if roofline else None,
         # Median of same-round take/roofline pairs from the
         # tight ~2 GB probe (seconds per sample, so the pair
         # genuinely shares a host/disk window; full-scale
@@ -746,25 +791,48 @@ def main() -> None:
         "roofline_fraction_runs": [
             round(f, 3) for f in take_probe_fracs
         ],
-        # Full-scale pairs for the same metric, published so
-        # the redefinition is auditable: at 20 GB each pair
-        # member spans minutes and host contention drifts
-        # inside the pair, which is WHY the headline fraction
-        # moved to the probe scale (r4->r5).
-        "roofline_fraction_fullscale": round(
-            statistics.median(take_fracs), 3
+        # Full-scale fractions from IN-TAKE INTERLEAVED PROBES
+        # (TPUSNAP_PROBE through the take's own scheduler): each
+        # take self-measures its engine ceiling seconds from the
+        # writes it judges, so the fraction is immune to the
+        # multi-minute disk drift that made the former separate
+        # roofline session scatter 0.206–0.707 (see
+        # BENCHMARKS.md "Round 7 protocol change").
+        "roofline_fullscale_source": "intake_probes",
+        # Failed-probe runs publish null at their index (every *_runs
+        # array stays aligned with take_runs_s and cold_run_index) and
+        # are excluded from the aggregates.
+        "roofline_fraction_fullscale": (
+            round(statistics.median(_fracs_valid), 3)
+            if _fracs_valid
+            else None
         ),
         "roofline_fraction_fullscale_runs": [
-            round(f, 3) for f in take_fracs
+            round(f, 3) if f is not None else None for f in take_fracs
         ],
+        "probe_write_gbps_runs": [
+            round(r, 3) if r is not None else None for r in rooflines
+        ],
+        "probe_overhead_s_runs": [
+            round(p, 2) for p in probe_overheads
+        ],
+        "probe_interval_gb": round(probe_interval / 1024**3, 2),
+        "probe_bytes_mb": round(probe_bytes / 1024**2, 1),
         # Index of the cold-cache run in every *_runs array of
         # this JSON (the section's first run), plus warm-only
         # aggregates so trend tooling doesn't flag warmup.
         "cold_run_index": 0,
-        "roofline_fraction_fullscale_warm": round(
-            statistics.median(_warm(take_fracs)), 3
+        "roofline_fraction_fullscale_warm": (
+            round(statistics.median(_warm_fracs_valid), 3)
+            if _warm_fracs_valid
+            else None
         ),
-        "roofline_runs_gbps": [round(r, 3) for r in rooflines],
+        # Since round 7 these are the in-take probe ceilings (the
+        # name kept for BENCH_r01-r06 trend comparability; null at a
+        # failed-probe run's index).
+        "roofline_runs_gbps": [
+            round(r, 3) if r is not None else None for r in rooflines
+        ],
         "take_runs_s": [round(t, 2) for t in times],
         "take_warm_best_s": round(min(_warm(times)), 2),
         "stage_breakdown": stage_breakdown,
@@ -871,6 +939,11 @@ def main() -> None:
     try:
         from tpusnap import history as _hist
 
+        # Tail-latency gate feed: p99/p50 storage-write latency of the
+        # best take's log2 histograms (event_from_summary derives the
+        # same fields take events carry, so `history --check --kind
+        # bench --metric storage_write_p99_s` gates like-for-like).
+        _hist_fields = _hist.event_from_summary("bench", best_summary or {})
         _hist.record_event(
             {
                 "v": 1,
@@ -881,7 +954,19 @@ def main() -> None:
                 "bytes": nbytes,
                 "wall_s": round(best, 3),
                 "throughput_gbps": round(gbps, 3),
+                **{
+                    k: _hist_fields[k]
+                    for k in (
+                        "storage_write_p50_s",
+                        "storage_write_p99_s",
+                        "probe_write_gbps",
+                    )
+                    if k in _hist_fields
+                },
                 "roofline_fraction": result["roofline_fraction"],
+                "roofline_fraction_fullscale": result[
+                    "roofline_fraction_fullscale"
+                ],
                 "roofline_fraction_fullscale_warm": result[
                     "roofline_fraction_fullscale_warm"
                 ],

@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# One-entrypoint CI/cron gate for tpusnap:
+#
+#   1. tier-1 tests (the ROADMAP.md verify command)
+#   2. `tpusnap history --check` — cross-run regression gate on this
+#      host's history.jsonl: take throughput AND p99 storage-write
+#      latency (insufficient history — exit 3 — passes, so a fresh
+#      host bootstraps instead of failing forever)
+#   3. `tpusnap analyze --check` — performance doctor on the newest
+#      bench/CI snapshot (tail latency, stragglers, roofline), when
+#      one is available
+#
+# Usage:
+#   scripts/ci_gate.sh [SNAPSHOT_PATH]
+#
+#   SNAPSHOT_PATH        snapshot for step 3 (default: $TPUSNAP_CI_SNAPSHOT,
+#                        else step 3 is skipped with a note)
+#   TPUSNAP_CI_SKIP_TESTS=1   skip step 1 (cron boxes that only gate
+#                             perf trends, not code)
+#
+# Exit: non-zero on the first failing gate, echoing which one.
+
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+fail() { echo "ci_gate: FAIL — $1" >&2; exit "$2"; }
+
+# ---- 1. tier-1 -----------------------------------------------------------
+if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
+    echo "ci_gate: [1/3] tier-1 tests"
+    rm -f /tmp/_t1.log
+    timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m 'not slow' --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+    rc=${PIPESTATUS[0]}
+    echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+    [ "$rc" -eq 0 ] || fail "tier-1 tests (rc=$rc)" "$rc"
+else
+    echo "ci_gate: [1/3] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
+fi
+
+# ---- 2. cross-run history gate ------------------------------------------
+echo "ci_gate: [2/3] history --check (throughput + p99 write latency)"
+for kind in take bench; do
+    python -m tpusnap history --check --kind "$kind" \
+        --metric throughput_gbps --metric storage_write_p99_s --json
+    rc=$?
+    case "$rc" in
+        0) echo "ci_gate: history[$kind] OK" ;;
+        3) echo "ci_gate: history[$kind] insufficient comparable history (bootstrapping) — pass" ;;
+        *) fail "history --check --kind $kind regressed (rc=$rc)" "$rc" ;;
+    esac
+done
+
+# ---- 3. analyze doctor on the latest snapshot ---------------------------
+SNAP="${1:-${TPUSNAP_CI_SNAPSHOT:-}}"
+if [ -n "$SNAP" ]; then
+    echo "ci_gate: [3/3] analyze --check $SNAP"
+    python -m tpusnap analyze --check --history "$SNAP"
+    rc=$?
+    case "$rc" in
+        0) echo "ci_gate: analyze OK" ;;
+        3) echo "ci_gate: analyze found no telemetry in $SNAP — pass (knob-off take)" ;;
+        *) fail "analyze --check $SNAP (rc=$rc)" "$rc" ;;
+    esac
+else
+    echo "ci_gate: [3/3] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
+fi
+
+echo "ci_gate: PASS"

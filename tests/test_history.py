@@ -286,7 +286,16 @@ def test_history_cli_table_json_and_check(history_env, capsys):
     assert main(["history", "--check"]) == 2
     assert "REGRESSION" in capsys.readouterr().out
     assert main(["history", "--check", "--json"]) == 2
-    assert json.loads(capsys.readouterr().out)["regressed"] is True
+    doc = json.loads(capsys.readouterr().out)
+    # The machine-readable contract NAMES each regressed metric and
+    # carries its latest/baseline/window values.
+    assert doc["regressed"] == ["throughput_gbps"]
+    assert doc["ok"] is False
+    (check,) = doc["checks"]
+    assert check["metric"] == "throughput_gbps"
+    assert check["latest"] == pytest.approx(0.3)
+    assert check["baseline_median"] == pytest.approx(1.0)
+    assert check["window"] == 20 and check["n_baseline"] >= 3
     # A cold-run-only outlier on top: exit 0.
     record_event(_synth(9, 0.2, cold=True))
     assert main(["history", "--check"]) == 0
@@ -322,3 +331,102 @@ def test_history_cli_kind_filter(history_env, capsys):
     assert [e["kind"] for e in doc["events"]] == ["bench"]
     assert main(["history", "--kind", "all", "--json"]) == 0
     assert len(json.loads(capsys.readouterr().out)["events"]) == 2
+
+
+def test_history_cli_multi_metric_check(history_env, capsys):
+    """One gate invocation covers throughput AND p99 write latency:
+    only the latency regresses; the JSON names it, exit 2 fires."""
+    for i in range(8):
+        record_event(_synth(i, 1.0, storage_write_p99_s=0.01))
+    # Throughput fine, p99 write latency 10x (a *_s metric: upward).
+    record_event(_synth(8, 1.0, storage_write_p99_s=0.1))
+    rc = main(
+        [
+            "history",
+            "--check",
+            "--metric",
+            "throughput_gbps",
+            "--metric",
+            "storage_write_p99_s",
+            "--json",
+        ]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert doc["regressed"] == ["storage_write_p99_s"]
+    by_metric = {c["metric"]: c for c in doc["checks"]}
+    assert by_metric["throughput_gbps"]["regressed"] is False
+    assert by_metric["storage_write_p99_s"]["regressed"] is True
+    assert by_metric["storage_write_p99_s"]["latest"] == pytest.approx(0.1)
+    assert by_metric["storage_write_p99_s"]["baseline_median"] == pytest.approx(
+        0.01
+    )
+    # Comma-splitting is equivalent to repeating the flag.
+    assert (
+        main(
+            [
+                "history",
+                "--check",
+                "--metric",
+                "throughput_gbps,storage_write_p99_s",
+            ]
+        )
+        == 2
+    )
+    assert "storage_write_p99_s" in capsys.readouterr().out
+
+
+def test_history_cli_multi_metric_partial_coverage_passes(
+    history_env, capsys
+):
+    """A metric absent from the events cannot be checked, but the gate
+    passes while a checkable metric is green (a fleet upgrading to the
+    histogram fields must not fail until old events age out)."""
+    for i in range(8):
+        record_event(_synth(i, 1.0))  # no storage_write_p99_s anywhere
+    rc = main(
+        [
+            "history",
+            "--check",
+            "--metric",
+            "throughput_gbps",
+            "--metric",
+            "storage_write_p99_s",
+            "--json",
+        ]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["regressed"] == []
+    # ...and when NO metric can form a verdict: exit 3, as ever.
+    assert (
+        main(["history", "--check", "--metric", "no_such_metric"]) == 3
+    )
+    capsys.readouterr()
+
+
+def test_event_from_summary_carries_write_latency_quantiles():
+    """Take summaries with io_histograms produce gateable
+    storage_write_p50_s/p99_s event fields (merged across plugins)."""
+    from tpusnap.telemetry import IOStats
+
+    st = IOStats()
+    for _ in range(98):
+        st.observe(0.004, 1 << 20)
+    st.observe(0.4, 1 << 20)
+    st.observe(0.4, 1 << 20)
+    summary = {
+        "rank": 0,
+        "take_wall_s": 2.0,
+        "counters": {"storage.bytes_written": 100 << 20},
+        "io_histograms": {
+            "write.FSStoragePlugin": st.to_dict(),
+            "read.FSStoragePlugin": IOStats().to_dict(),
+        },
+    }
+    ev = hist.event_from_summary("take", summary)
+    assert ev["storage_write_p50_s"] <= 0.009
+    assert ev["storage_write_p99_s"] >= 0.25
+    # No histograms -> no fields (old events stay shaped as before).
+    ev2 = hist.event_from_summary("take", {"take_wall_s": 1.0})
+    assert "storage_write_p99_s" not in ev2

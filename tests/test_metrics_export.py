@@ -112,6 +112,47 @@ def test_prom_atomic_rewrite_no_temp_debris(tmp_path, metrics_env):
     assert not [f for f in os.listdir(metrics_env) if ".tmp." in f]
 
 
+def test_prom_exports_storage_latency_quantiles(tmp_path, metrics_env):
+    """Histogram quantiles from the process-global I/O histograms:
+    summary-typed ``tpusnap_storage_write_seconds{quantile=...,plugin=
+    ...}`` series, surviving the strict format self-check, quantiles
+    ordered, and the monotonic-domain rule untouched (quantiles are
+    point-in-time; only *_total families are counters)."""
+    # The exported domain is process-global: earlier tests' backends
+    # (fsspec doubles, chaos runs) would otherwise share the family.
+    from tpusnap import telemetry
+
+    telemetry.reset_global_io_histograms()
+    with override_metrics_export("prom"):
+        Snapshot.take(str(tmp_path / "s"), {"m": PytreeState(_state())})
+        text = open(_prom_path(metrics_env)).read()
+    metrics = parse_prometheus_textfile(text)
+    fam = metrics["tpusnap_storage_write_seconds"]
+    assert fam["type"] == "summary"
+    by_q = {}
+    for labels, value in fam["samples"].items():
+        assert 'plugin="FSStoragePlugin"' in labels
+        assert 'rank="0"' in labels
+        for q in ("0.5", "0.95", "0.99"):
+            if f'quantile="{q}"' in labels:
+                by_q[q] = value
+    assert set(by_q) == {"0.5", "0.95", "0.99"}
+    assert 0 < by_q["0.5"] <= by_q["0.95"] <= by_q["0.99"]
+    # The read family appears once reads happen (a restore).
+    state = _state()
+    with override_metrics_export("prom"):
+        Snapshot(str(tmp_path / "s")).restore(
+            {"m": PytreeState({k: np.zeros_like(v) for k, v in state.items()})}
+        )
+        text = open(_prom_path(metrics_env)).read()
+    assert (
+        parse_prometheus_textfile(text)["tpusnap_storage_read_seconds"][
+            "type"
+        ]
+        == "summary"
+    )
+
+
 @pytest.mark.chaos
 def test_prom_retry_classification_labels(tmp_path, metrics_env):
     with override_metrics_export("prom"):

@@ -20,59 +20,77 @@ def _run(coro):
 
 def test_registry_schemes(tmp_path):
     from tpusnap.retry import RetryingStoragePlugin
+    from tpusnap.storage_plugin import InstrumentedStoragePlugin
 
-    # Built-in plugins come wrapped in the whole-op retry middleware.
+    # Built-in plugins come wrapped retry(instrument(raw)): whole-op
+    # retry outermost, the histogram instrumentation inside it (so each
+    # attempt is one latency sample, without backoff sleeps).
     p = url_to_storage_plugin(str(tmp_path))
     assert isinstance(p, RetryingStoragePlugin)
-    assert isinstance(p.inner, FSStoragePlugin)
+    assert isinstance(p.inner, InstrumentedStoragePlugin)
+    assert isinstance(p.inner.inner, FSStoragePlugin)
+    assert p.inner.label == "FSStoragePlugin"
     p = url_to_storage_plugin(f"fs://{tmp_path}")
-    assert isinstance(p.inner, FSStoragePlugin)
-    # storage_options={"retry": False} returns the bare plugin.
+    assert isinstance(p.inner.inner, FSStoragePlugin)
+    # storage_options={"retry": False} drops retry, keeps instrumentation.
     p = url_to_storage_plugin(str(tmp_path), {"retry": False})
-    assert isinstance(p, FSStoragePlugin)
+    assert isinstance(p, InstrumentedStoragePlugin)
+    assert isinstance(p.inner, FSStoragePlugin)
     p = url_to_storage_plugin(f"fsspec+memory://snap")
     from tpusnap.storage_plugins.fsspec import FsspecStoragePlugin
 
-    assert isinstance(p.inner, FsspecStoragePlugin)
+    assert isinstance(p.inner.inner, FsspecStoragePlugin)
     with pytest.raises(RuntimeError, match="Unsupported storage scheme"):
         url_to_storage_plugin("bogus://x")
     # S3 construction succeeds without aiobotocore (deferred import so a
-    # stub client can be injected); first real use raises.
+    # stub client can be injected); first real use raises. Unknown
+    # attributes pass through the instrumentation wrapper.
     s3 = url_to_storage_plugin("s3://bucket/prefix")
     with pytest.raises(RuntimeError, match="aiobotocore"):
         _run(s3.inner._get_client())
 
 
 def test_registry_chaos_scheme(tmp_path):
-    """chaos+<scheme>:// composes Retrying(FaultInjection(raw)) so
-    injected faults exercise the production retry path."""
+    """chaos+<scheme>:// composes Retrying(Instrumented(FaultInjection(
+    raw))) so injected faults exercise the production retry path AND
+    injected latency lands in the histograms as the fat tail it is."""
     from tpusnap.faults import FaultInjectionStoragePlugin, FaultPlan
     from tpusnap.retry import RetryingStoragePlugin
+    from tpusnap.storage_plugin import InstrumentedStoragePlugin
+
+    def _unwrap(plugin):
+        assert isinstance(plugin, RetryingStoragePlugin)
+        assert isinstance(plugin.inner, InstrumentedStoragePlugin)
+        return plugin.inner.inner
 
     p = url_to_storage_plugin(f"chaos+fs://{tmp_path}")
-    assert isinstance(p, RetryingStoragePlugin)
-    assert isinstance(p.inner, FaultInjectionStoragePlugin)
-    assert isinstance(p.inner.inner, FSStoragePlugin)
-    # default plan: ≥1 transient error per distinct op
+    fault = _unwrap(p)
+    assert isinstance(fault, FaultInjectionStoragePlugin)
+    assert isinstance(fault.inner, FSStoragePlugin)
+    # ...and the instrumentation labels by the RAW backend class.
+    assert p.inner.label == "FSStoragePlugin"
+    # default plan: ≥1 transient error per distinct op. (Attribute
+    # passthrough: p.inner.plan delegates through the instrumentation.)
+    assert fault.plan.transient_per_op == 1
     assert p.inner.plan.transient_per_op == 1
     # explicit plans ride storage_options (FaultPlan, spec str, or dict)
     p = url_to_storage_plugin(
         f"chaos+fs://{tmp_path}",
         {"fault_plan": FaultPlan(seed=7, transient_every=3, torn_writes=True)},
     )
-    assert p.inner.plan.seed == 7 and p.inner.plan.torn_writes
+    assert _unwrap(p).plan.seed == 7 and _unwrap(p).plan.torn_writes
     p = url_to_storage_plugin(
         f"chaos+fs://{tmp_path}",
         {"fault_plan": "seed=2,transient_per_op=2,latency_ms=1"},
     )
-    assert p.inner.plan.seed == 2
-    assert p.inner.plan.transient_per_op == 2
-    assert abs(p.inner.plan.latency_sec - 0.001) < 1e-9
+    assert _unwrap(p).plan.seed == 2
+    assert _unwrap(p).plan.transient_per_op == 2
+    assert abs(_unwrap(p).plan.latency_sec - 0.001) < 1e-9
     # chaos over the generic fsspec bridge
     p = url_to_storage_plugin("chaos+fsspec+memory://snapchaos")
     from tpusnap.storage_plugins.fsspec import FsspecStoragePlugin
 
-    assert isinstance(p.inner.inner, FsspecStoragePlugin)
+    assert isinstance(_unwrap(p).inner, FsspecStoragePlugin)
 
 
 def test_fs_write_read_roundtrip(tmp_path):

@@ -41,10 +41,16 @@ try:  # pragma: no cover - import surface grows as modules land
     from .retry import RetryPolicy  # noqa: F401
     from .faults import FaultPlan, InjectedFaultError  # noqa: F401
     from .telemetry import (  # noqa: F401
+        IOStats,
+        LogHistogram,
         MetricsSink,
         metrics_sink,
         register_metrics_sink,
         unregister_metrics_sink,
+    )
+    from .analyze import (  # noqa: F401
+        Attribution,
+        attribute_spans,
     )
     from .metrics_export import (  # noqa: F401
         JsonlEventSink,
@@ -58,6 +64,10 @@ try:  # pragma: no cover - import surface grows as modules land
     )
 
     __all__ += [
+        "IOStats",
+        "LogHistogram",
+        "Attribution",
+        "attribute_spans",
         "MetricsSink",
         "metrics_sink",
         "register_metrics_sink",

@@ -50,15 +50,32 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
                         records its runs too): trend table or ``--json``;
                         ``--check`` compares the latest run against the
                         trailing median (``--window``/``--threshold``,
-                        cold-run-aware) and exits 2 on a regression so
-                        CI and cron jobs can gate on it (exit 3 = not
-                        enough comparable history / no events)
+                        cold-run-aware; ``--metric`` repeatable — e.g.
+                        ``--metric throughput_gbps --metric
+                        storage_write_p99_s``, JSON names each regressed
+                        metric) and exits 2 on a regression so CI and
+                        cron jobs can gate on it (exit 3 = not enough
+                        comparable history / no events)
+  analyze     PATH      performance doctor: deterministic critical-path
+                        attribution of the take's (or ``--restore``'s)
+                        wall-clock to resources (storage write/read,
+                        DtoH, stage/clone, checksum, budget waits,
+                        barriers) with a bound-by verdict and the
+                        concrete knob to turn; tail-latency outliers
+                        from the storage-boundary latency histograms;
+                        straggler ranks; the in-take probe
+                        ``roofline_fraction`` (``TPUSNAP_PROBE=1``);
+                        ``--history`` adds trend context; ``--json``
+                        for machines; ``--check`` exits 2 when any
+                        warn-severity finding fires (exit 3 = no
+                        telemetry recorded, matching ``trace``)
 
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
-(or provably-different diff; history --check: regression), 3
-undecidable/unverifiable (or no telemetry recorded; fsck: empty/foreign;
-history: no/insufficient events), 4 torn take (fsck — salvageable by
-retaking the path).
+(or provably-different diff; history --check: regression; analyze
+--check: warn-severity finding), 3 undecidable/unverifiable (or no
+telemetry recorded — trace and analyze; fsck: empty/foreign; history:
+no/insufficient events), 4 torn take (fsck — salvageable by retaking
+the path).
 """
 
 from __future__ import annotations
@@ -439,34 +456,15 @@ def _render_trace(args, rollup, summaries, ranks, world_size, label) -> int:
     return 0
 
 
-def cmd_trace(args) -> int:
+def _load_take_traces(path: str):
+    """(world_size, rollup-or-None, {rank: trace doc}) for a committed
+    snapshot — the shared loader behind ``trace`` and ``analyze``."""
     import json as _json
-
-    from .telemetry import rollup_summaries
-
-    if args.restore:
-        from .progress import load_restore_traces, restore_trace_dir
-
-        docs = load_restore_traces(args.path)
-        if not docs:
-            print(
-                "no restore telemetry recorded for this path (no restore "
-                "ran from this machine, TPUSNAP_TELEMETRY=0, or a "
-                f"different TPUSNAP_TELEMETRY_DIR — looked in "
-                f"{restore_trace_dir(args.path)})",
-                file=sys.stderr,
-            )
-            return 3
-        summaries = {r: d.get("summary") or {} for r, d in docs.items()}
-        rollup = rollup_summaries(list(summaries.values()))
-        return _render_trace(
-            args, rollup, summaries, sorted(docs), len(docs), "restore"
-        )
 
     from .io_types import ReadIO
     from .telemetry import telemetry_rank_path
 
-    snap = Snapshot(args.path)
+    snap = Snapshot(path)
     md = snap.metadata
     rollup = (md.extras or {}).get("telemetry")
     ranks: dict = {}
@@ -479,6 +477,48 @@ def cmd_trace(args) -> int:
                 ranks[rank] = _json.loads(read_io.buf.getvalue().decode("utf-8"))
             except Exception:
                 continue  # telemetry disabled on this rank, or pre-telemetry snapshot
+    return md.world_size, rollup, ranks
+
+
+def _load_restore_docs(path: str):
+    """{rank: trace doc} for the last restore of ``path`` from the
+    local telemetry dir, or None (with the explanation printed) when
+    nothing was recorded."""
+    from .progress import load_restore_traces, restore_trace_dir
+
+    docs = load_restore_traces(path)
+    if not docs:
+        print(
+            "no restore telemetry recorded for this path (no restore "
+            "ran from this machine, TPUSNAP_TELEMETRY=0, or a "
+            f"different TPUSNAP_TELEMETRY_DIR — looked in "
+            f"{restore_trace_dir(path)})",
+            file=sys.stderr,
+        )
+        return None
+    return docs
+
+
+_NO_TELEMETRY_MSG = (
+    "no telemetry recorded (taken with TPUSNAP_TELEMETRY=0, or a "
+    "pre-telemetry snapshot)"
+)
+
+
+def cmd_trace(args) -> int:
+    from .telemetry import rollup_summaries
+
+    if args.restore:
+        docs = _load_restore_docs(args.path)
+        if docs is None:
+            return 3
+        summaries = {r: d.get("summary") or {} for r, d in docs.items()}
+        rollup = rollup_summaries(list(summaries.values()))
+        return _render_trace(
+            args, rollup, summaries, sorted(docs), len(docs), "restore"
+        )
+
+    world_size, rollup, ranks = _load_take_traces(args.path)
     summaries = {r: d.get("summary") or {} for r, d in ranks.items()}
     if rollup is None and summaries:
         rollup = rollup_summaries(list(summaries.values()))
@@ -490,15 +530,148 @@ def cmd_trace(args) -> int:
         s.get("stages") for s in summaries.values()
     )
     if not summaries and not has_spans:
-        print(
-            "no telemetry recorded (taken with TPUSNAP_TELEMETRY=0, or a "
-            "pre-telemetry snapshot)",
-            file=sys.stderr,
-        )
+        print(_NO_TELEMETRY_MSG, file=sys.stderr)
         return 3
     return _render_trace(
-        args, rollup, summaries, sorted(ranks), md.world_size, "take"
+        args, rollup, summaries, sorted(ranks), world_size, "take"
     )
+
+
+def _render_analyze(path: str, report: dict) -> None:
+    kind = report.get("kind", "take")
+    print(f"path:   {path}")
+    att = report.get("attribution")
+    if report.get("bound_by"):
+        print(
+            f"\nBOUND BY: {report['bound_by']} "
+            f"({report.get('bound_pct', 0):.1f}% of {kind} wall-clock, "
+            f"rank {report.get('rank')})"
+        )
+        if report.get("advice"):
+            print(f"  → {report['advice']}")
+    if att:
+        wall = att.get("wall_s") or 0.0
+        print(
+            f"\nattribution (rank {report.get('rank')}, "
+            f"wall {_fmt_seconds(wall)}, "
+            f"coverage {att.get('coverage', 0) * 100:.1f}%):"
+        )
+        print(f"{'resource':<16s} {'attributed':>11s} {'%':>6s} {'busy':>10s}")
+        pct = att.get("attributed_pct") or {}
+        busy = att.get("busy_s") or {}
+        for cat, secs in sorted(
+            (att.get("attributed_s") or {}).items(),
+            key=lambda kv: -kv[1],
+        ):
+            print(
+                f"{cat:<16s} {_fmt_seconds(secs):>11s} "
+                f"{pct.get(cat, 0):>5.1f}% "
+                f"{_fmt_seconds(busy.get(cat)):>10s}"
+            )
+        ua = att.get("unattributed_s") or 0.0
+        if wall > 0:
+            print(
+                f"{'(unattributed)':<16s} {_fmt_seconds(ua):>11s} "
+                f"{100.0 * ua / wall:>5.1f}%"
+            )
+    hist = report.get("io_histograms")
+    if hist:
+        print("\nstorage-boundary latency (log2 histograms, all ranks):")
+        print(
+            f"{'op.plugin':<28s} {'count':>6s} {'p50':>9s} {'p95':>9s} "
+            f"{'p99':>9s} {'max':>9s}"
+        )
+        for key, st in sorted(hist.items()):
+            print(
+                f"{key:<28s} {st.get('count', 0):>6d} "
+                f"{_fmt_seconds(st.get('p50_s')):>9s} "
+                f"{_fmt_seconds(st.get('p95_s')):>9s} "
+                f"{_fmt_seconds(st.get('p99_s')):>9s} "
+                f"{_fmt_seconds(st.get('max_s')):>9s}"
+            )
+    if report.get("roofline_fraction") is not None:
+        line = f"\nroofline: {report['roofline_fraction']:.1%} of the in-take probe ceiling"
+        probe = report.get("probe") or {}
+        if probe.get("write_gbps_p50"):
+            line += (
+                f" ({probe['write_gbps_p50']:.2f} GB/s over "
+                f"{probe.get('probes', 0)} probe(s))"
+            )
+        print(line)
+    trend = report.get("history")
+    if trend and trend.get("events"):
+        print(f"\nhistory trend (last {trend['events']} {kind} event(s)):")
+        for metric, agg in trend.items():
+            if not isinstance(agg, dict):
+                continue
+            print(
+                f"  {metric}: latest {agg.get('latest')} vs median "
+                f"{agg.get('median')} (n={agg.get('n')})"
+            )
+    findings = report.get("findings") or []
+    if findings:
+        print("\nfindings:")
+        for f in findings:
+            print(f"  [{f['severity'].upper()}] {f['message']}")
+    else:
+        print("\nfindings: none — no gate-worthy anomalies")
+
+
+def cmd_analyze(args) -> int:
+    import json as _json
+
+    from .analyze import Thresholds, analyze
+    from .telemetry import rollup_summaries
+
+    thresholds = Thresholds(
+        p99_ratio=args.p99_ratio,
+        min_roofline=args.min_roofline,
+        max_skew=args.max_skew,
+    )
+    history_events = None
+    if args.history:
+        from .history import load_history
+
+        history_events = load_history()
+    if args.restore:
+        docs = _load_restore_docs(args.path)
+        if docs is None:
+            return 3
+        rank_docs = docs
+        rollup = rollup_summaries(
+            [d.get("summary") or {} for d in docs.values()]
+        )
+        kind = "restore"
+    else:
+        _world, rollup, rank_docs = _load_take_traces(args.path)
+        if rollup is None and rank_docs:
+            rollup = rollup_summaries(
+                [d.get("summary") or {} for d in rank_docs.values()]
+            )
+        kind = "take"
+    # Zero spans anywhere (knob-off take OR pre-telemetry snapshot):
+    # there is nothing to attribute — one-liner + exit 3, matching
+    # `trace`.
+    has_spans = bool((rollup or {}).get("stages")) or any(
+        (d.get("summary") or {}).get("stages") for d in rank_docs.values()
+    )
+    if not rank_docs or not has_spans:
+        print(_NO_TELEMETRY_MSG, file=sys.stderr)
+        return 3
+    report = analyze(
+        rollup,
+        rank_docs,
+        kind=kind,
+        thresholds=thresholds,
+        history_events=history_events,
+    )
+    if args.json:
+        print(_json.dumps({"path": args.path, **report}))
+    else:
+        _render_analyze(args.path, report)
+    if args.check and report.get("check_failed"):
+        return 2
+    return 0
 
 
 def cmd_watch(args) -> int:
@@ -585,32 +758,61 @@ def cmd_history(args) -> int:
                 file=sys.stderr,
             )
             return 1
-        report = check_regression(
-            events,
-            kind=args.kind,
-            metric=args.metric,
-            window=args.window,
-            threshold=args.threshold,
-            min_baseline=args.min_baseline,
-        )
-        if args.json:
-            print(_json.dumps({"file": path, **report.to_json()}))
-        else:
-            verdict = (
-                "REGRESSION"
-                if report.regressed
-                else ("OK" if report.ok else "INSUFFICIENT DATA")
+        # --metric is repeatable (and comma-splittable): one gate run
+        # covers throughput AND the p99 storage-write latency (and any
+        # other recorded scalar) in a single invocation.
+        metrics: list = []
+        for m in args.metric or ["throughput_gbps"]:
+            metrics.extend(t.strip() for t in m.split(",") if t.strip())
+        reports = [
+            check_regression(
+                events,
+                kind=args.kind,
+                metric=m,
+                window=args.window,
+                threshold=args.threshold,
+                min_baseline=args.min_baseline,
             )
-            print(f"{verdict} [{report.kind}]: {report.reason}")
-            if report.baseline_median is not None:
-                print(
-                    f"  latest {report.latest:.4g} vs trailing-median "
-                    f"{report.baseline_median:.4g} over {report.n_baseline} "
-                    f"run(s) (threshold {report.threshold:.0%})"
+            for m in metrics
+        ]
+        regressed = [r for r in reports if r.regressed]
+        any_ok = any(r.ok for r in reports)
+        if args.json:
+            # Machine-readable contract: every regressed metric is
+            # NAMED, with its latest/baseline/window values, so a CI
+            # wrapper never has to parse prose.
+            print(
+                _json.dumps(
+                    {
+                        "file": path,
+                        "kind": args.kind,
+                        "ok": any_ok and not regressed,
+                        "regressed": [r.metric for r in regressed],
+                        "checks": [r.to_json() for r in reports],
+                    }
                 )
-        if report.regressed:
+            )
+        else:
+            for report in reports:
+                verdict = (
+                    "REGRESSION"
+                    if report.regressed
+                    else ("OK" if report.ok else "INSUFFICIENT DATA")
+                )
+                print(f"{verdict} [{report.kind}/{report.metric}]: {report.reason}")
+                if report.baseline_median is not None:
+                    print(
+                        f"  latest {report.latest:.4g} vs trailing-median "
+                        f"{report.baseline_median:.4g} over {report.n_baseline} "
+                        f"run(s) (threshold {report.threshold:.0%})"
+                    )
+        # Exit contract unchanged: 2 = any metric regressed, 3 = no
+        # metric could form a verdict at all, 0 otherwise (a metric
+        # absent from older events does not fail the gate while the
+        # checkable ones pass).
+        if regressed:
             return 2
-        return 0 if report.ok else 3
+        return 0 if any_ok else 3
     shown = [
         e for e in events if args.kind == "all" or e.get("kind") == args.kind
     ]
@@ -789,9 +991,10 @@ def main(argv=None) -> int:
         "exit 2 on regression, 3 on insufficient comparable history",
     )
     p.add_argument(
-        "--metric", default="throughput_gbps",
-        help="event field to check (default throughput_gbps; *_s metrics "
-        "regress upward)",
+        "--metric", action="append", default=None, metavar="M",
+        help="event field(s) to check — repeatable and comma-splittable "
+        "(default throughput_gbps; *_s metrics such as "
+        "storage_write_p99_s regress upward)",
     )
     p.add_argument(
         "--window", type=int, default=20, metavar="N",
@@ -809,6 +1012,49 @@ def main(argv=None) -> int:
         "(default 3)",
     )
     p.set_defaults(fn=cmd_history)
+
+    p = sub.add_parser(
+        "analyze",
+        help="performance doctor: bound-by verdict + knob advice, "
+        "tail-latency outliers, stragglers, roofline fraction",
+    )
+    p.add_argument("path")
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="exit 2 when any warn-severity finding fires (tail "
+        "latency, straggler skew, roofline shortfall) — the CI gate",
+    )
+    p.add_argument(
+        "--restore", action="store_true",
+        help="analyze the LAST restore's traces (local "
+        "TPUSNAP_TELEMETRY_DIR) instead of the take's",
+    )
+    p.add_argument(
+        "--history", action="store_true",
+        help="add trend context from this host's history.jsonl",
+    )
+    p.add_argument(
+        "--p99-ratio", type=float, default=20.0, metavar="R",
+        dest="p99_ratio",
+        help="flag an op whose p99 latency exceeds R x its p50 "
+        "(default 20)",
+    )
+    p.add_argument(
+        "--min-roofline", type=float, default=0.4, metavar="F",
+        dest="min_roofline",
+        help="flag a take below this fraction of its in-take probe "
+        "ceiling (default 0.4; needs TPUSNAP_PROBE=1 at take time)",
+    )
+    p.add_argument(
+        "--max-skew", type=float, default=2.0, metavar="S",
+        dest="max_skew",
+        help="flag a phase whose slowest rank exceeds S x the p50 "
+        "(default 2.0)",
+    )
+    p.set_defaults(fn=cmd_analyze)
 
     p = sub.add_parser(
         "fsck",

@@ -132,6 +132,37 @@ def event_from_summary(kind: str, summary: Dict[str, Any]) -> Dict[str, Any]:
     # pipelined-staging win cannot silently regress.
     if isinstance(summary.get("async_blocked_s"), (int, float)):
         ev["async_blocked_s"] = round(float(summary["async_blocked_s"]), 6)
+    # Storage-boundary write-latency quantiles from the take's log2
+    # histograms (merged across plugin classes): *_s metrics, so
+    # `history --check --metric storage_write_p99_s` gates tail latency
+    # upward exactly like every other duration.
+    write_lat = None
+    for key, st in (summary.get("io_histograms") or {}).items():
+        if not key.startswith("write."):
+            continue
+        try:
+            from .telemetry import LogHistogram
+
+            h = LogHistogram.from_dict(st.get("latency") or {})
+        except Exception:
+            continue
+        if write_lat is None:
+            write_lat = h
+        else:
+            write_lat.merge(h)
+    if write_lat is not None and write_lat.count:
+        p50, p99 = write_lat.quantile(0.5), write_lat.quantile(0.99)
+        if p50 is not None:
+            ev["storage_write_p50_s"] = round(p50, 6)
+        if p99 is not None:
+            ev["storage_write_p99_s"] = round(p99, 6)
+    # In-take roofline probes (TPUSNAP_PROBE=1): the drift-immune
+    # fraction and the measured ceiling ride the trend.
+    if isinstance(summary.get("roofline_fraction"), (int, float)):
+        ev["roofline_fraction"] = round(float(summary["roofline_fraction"]), 4)
+        pw = (summary.get("probe") or {}).get("write_gbps_p50")
+        if pw:
+            ev["probe_write_gbps"] = pw
     return ev
 
 

@@ -28,6 +28,15 @@ from typing import Generic, List, Optional, Tuple, TypeVar, Union
 
 BufferType = Union[bytes, bytearray, memoryview]
 
+# The snapshot-internal sidecar namespace: telemetry traces, progress
+# heartbeats, journal records, roofline probe streams. The ONE
+# definition of the namespace root, shared by the layers that exempt
+# whole-namespace traffic — journaling and histogram sampling — so it
+# cannot silently drift apart. (fsck classifies per FAMILY under this
+# root: lifecycle._is_legit_sidecar and the empty/foreign exemptions
+# name specific subdirectories, deliberately narrower than the root.)
+SIDECAR_PREFIX = ".tpusnap/"
+
 T = TypeVar("T")
 
 

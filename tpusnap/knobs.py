@@ -41,6 +41,9 @@ _HISTORY_MAX_BYTES_ENV_VAR = "TPUSNAP_HISTORY_MAX_BYTES"
 _STAGE_THREADS_ENV_VAR = "TPUSNAP_STAGE_THREADS"
 _ASYNC_STAGE_WINDOW_ENV_VAR = "TPUSNAP_ASYNC_STAGE_WINDOW_BYTES"
 _ASYNC_COW_ENV_VAR = "TPUSNAP_ASYNC_COW"
+_PROBE_ENV_VAR = "TPUSNAP_PROBE"
+_PROBE_INTERVAL_ENV_VAR = "TPUSNAP_PROBE_INTERVAL_BYTES"
+_PROBE_BYTES_ENV_VAR = "TPUSNAP_PROBE_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -59,6 +62,13 @@ _DEFAULT_TILE_CHECKSUM_BYTES = 16 * 1024 * 1024
 # max-size chunks (2 x 512 MB, cost 2x while the clone is held) fit, so
 # the drain overlaps clone(N+1) with write(N) instead of serializing.
 _DEFAULT_ASYNC_STAGE_WINDOW_BYTES = 2 * 1024 * 1024 * 1024
+# In-take roofline probes: one probe segment per this many payload
+# bytes written, each probe writing (and reading back) this many raw
+# bytes through the take's own plugin stack. At the defaults the probe
+# overhead is bounded by PROBE_BYTES / PROBE_INTERVAL ≈ 3% of the
+# take's I/O, and a 20 GB take self-measures its ceiling ~10 times.
+_DEFAULT_PROBE_INTERVAL_BYTES = 2 * 1024 * 1024 * 1024
+_DEFAULT_PROBE_BYTES = 64 * 1024 * 1024
 
 
 def _get_float_env(name: str, default: float) -> float:
@@ -347,6 +357,39 @@ def is_async_cow_enabled() -> bool:
     return os.environ.get(_ASYNC_COW_ENV_VAR, "0") == "1"
 
 
+def is_probe_enabled() -> bool:
+    """In-take roofline probes (``TPUSNAP_PROBE=1``, off by default):
+    the write scheduler interleaves tiny raw write/read probe segments
+    between I/O windows — through the SAME storage plugin stack the
+    take's blobs use — so every take self-measures its achievable
+    storage ceiling and carries a drift-immune ``roofline_fraction`` in
+    its summary, rollup and history event. Opt-in because the probes
+    cost real I/O (bounded by PROBE_BYTES/PROBE_INTERVAL, ~3% at the
+    defaults) and only run when telemetry is enabled."""
+    return os.environ.get(_PROBE_ENV_VAR, "0") == "1"
+
+
+def get_probe_interval_bytes() -> int:
+    """Payload bytes written between in-take roofline probe segments.
+    Floor of 16 MiB so a misconfigured cadence cannot turn the take
+    into a probe benchmark."""
+    return max(
+        16 * 1024 * 1024,
+        _get_int_env(_PROBE_INTERVAL_ENV_VAR, _DEFAULT_PROBE_INTERVAL_BYTES),
+    )
+
+
+def get_probe_bytes() -> int:
+    """Raw bytes one probe segment writes (then reads back) through the
+    take's plugin stack, split across a few concurrent streams to
+    measure the AGGREGATE ceiling the take's own parallel writes see.
+    Floor of 1 MiB: smaller probes measure syscall latency, not
+    bandwidth."""
+    return max(
+        1024 * 1024, _get_int_env(_PROBE_BYTES_ENV_VAR, _DEFAULT_PROBE_BYTES)
+    )
+
+
 def get_memory_budget_override_bytes() -> Optional[int]:
     if _MEMORY_BUDGET_ENV_VAR not in os.environ:
         return None
@@ -514,4 +557,28 @@ def override_async_stage_window_bytes(nbytes: int) -> Generator[None, None, None
 @contextlib.contextmanager
 def override_async_cow(enabled: bool) -> Generator[None, None, None]:
     with _override_env(_ASYNC_COW_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_probe(
+    enabled: bool,
+    interval_bytes: Optional[int] = None,
+    probe_bytes: Optional[int] = None,
+) -> Generator[None, None, None]:
+    """Enable/disable in-take roofline probes, optionally overriding
+    the cadence and probe size in the same scope (None leaves the
+    corresponding env var untouched)."""
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(
+            _override_env(_PROBE_ENV_VAR, "1" if enabled else "0")
+        )
+        if interval_bytes is not None:
+            stack.enter_context(
+                _override_env(_PROBE_INTERVAL_ENV_VAR, str(interval_bytes))
+            )
+        if probe_bytes is not None:
+            stack.enter_context(
+                _override_env(_PROBE_BYTES_ENV_VAR, str(probe_bytes))
+            )
         yield

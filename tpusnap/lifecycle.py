@@ -46,7 +46,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import _native, telemetry
-from .io_types import ReadIO, StoragePlugin, WriteIO
+from .io_types import SIDECAR_PREFIX, ReadIO, StoragePlugin, WriteIO
 from .manifest import MetadataError, SnapshotMetadata, decode_metadata
 
 logger = logging.getLogger(__name__)
@@ -61,10 +61,15 @@ __all__ = [
 
 JOURNAL_FNAME = ".tpusnap/journal"
 JOURNAL_RECORDS_DIR = ".tpusnap/journal.d"
-_SIDECAR_PREFIX = ".tpusnap/"
+_SIDECAR_PREFIX = SIDECAR_PREFIX  # canonical definition: io_types
 # Heartbeat records (tpusnap.progress): observability-only — ignored by
 # fsck's empty/foreign decision, legit in committed snapshots.
 _PROGRESS_SIDECAR_PREFIX = ".tpusnap/progress/"
+# Roofline probe streams (scheduler._ProbeRunner, TPUSNAP_PROBE=1):
+# transient; ignored by the empty/foreign decision (a stranded stream
+# must not make an aborted dir unreusable) but NOT legit post-commit —
+# in a committed snapshot a leftover is an orphan gc reclaims.
+_PROBE_SIDECAR_PREFIX = ".tpusnap/probe/"
 
 
 def journal_rank_path(rank: int) -> str:
@@ -651,11 +656,15 @@ def _fsck_impl(
     # breadcrumbs, never take evidence or payload: an ABORTED take
     # cleans its blobs and journal but leaves its final "aborted"
     # record for post-mortems — the path must still read as empty
-    # (reusable), not foreign.
+    # (reusable), not foreign. Roofline probe streams (.tpusnap/probe/,
+    # TPUSNAP_PROBE=1) are the same class: transient raw bytes a flaky
+    # backend's failed cleanup can strand in an aborted dir; they must
+    # not lock the path into "foreign" (which gc refuses) — in any
+    # OTHER state they stay orphan-visible and reclaimable.
     meaningful = {
         p: sz
         for p, sz in files.items()
-        if not p.startswith(_PROGRESS_SIDECAR_PREFIX)
+        if not p.startswith((_PROGRESS_SIDECAR_PREFIX, _PROBE_SIDECAR_PREFIX))
     }
     if meaningful:
         report.state = "foreign"

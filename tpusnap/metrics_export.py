@@ -92,6 +92,11 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
     - ``tpusnap_salvage_bytes_total``, ``tpusnap_dedup_skips_total``.
     - ``tpusnap_budget_high_water_bytes``,
       ``tpusnap_peak_rss_delta_bytes`` — gauges from the last summary.
+    - ``tpusnap_storage_write_seconds`` /
+      ``tpusnap_storage_read_seconds`` — summary-typed latency
+      quantiles (``quantile="0.5|0.95|0.99"``, ``plugin=<class>``) from
+      the process-global log2 histograms recorded at the
+      storage-plugin boundary.
     - ``tpusnap_last_summary_timestamp_seconds`` — staleness probe.
     """
 
@@ -237,6 +242,36 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
             "Incremental-dedup skipped blob writes.",
             [({}, counters.get("scheduler.dedup_skipped", 0))],
         )
+        # Storage-boundary latency quantiles from the PROCESS-GLOBAL
+        # log2 histograms (one summary-typed family per op, labeled by
+        # plugin class): the tail the whole-op gauges average away.
+        # Quantiles are point-in-time values, not counters — the
+        # monotonic-domain rule applies to the *_total families only.
+        io_hist = telemetry.global_io_histograms_snapshot()
+        for op in ("write", "read"):
+            samples: List[Tuple[Dict[str, str], float]] = []
+            for key, st in io_hist.items():
+                key_op, _, plugin = key.partition(".")
+                if key_op != op or not st.get("count"):
+                    continue
+                for qname, qkey in (
+                    ("0.5", "p50_s"),
+                    ("0.95", "p95_s"),
+                    ("0.99", "p99_s"),
+                ):
+                    v = st.get(qkey)
+                    if v is not None:
+                        samples.append(
+                            ({"plugin": plugin, "quantile": qname}, v)
+                        )
+            if samples:
+                metric(
+                    f"tpusnap_storage_{op}_seconds",
+                    "summary",
+                    f"Storage-plugin {op} latency quantiles "
+                    "(process-lifetime log2 histograms, per plugin class).",
+                    samples,
+                )
         if "scheduler.budget_used_bytes" in self._last_gauges:
             metric(
                 "tpusnap_budget_high_water_bytes",

@@ -350,7 +350,7 @@ class ProgressMonitor:
             self._mbps = round((written - prev_b) / max(now - prev_t, 1e-9) / 1e6, 1)
             self._last_rate_point = (now, written)
         ops = snap["ops"]
-        return {
+        rec = {
             "v": 1,
             "rank": self.rank,
             "world_size": self.world_size,
@@ -369,6 +369,12 @@ class ProgressMonitor:
             "elapsed_s": round(now - self._start_t, 2),
             "ts": self._wall(),
         }
+        # In-take roofline probes (TPUSNAP_PROBE=1): the latest measured
+        # ceiling, so `watch` can render live MB/s as a fraction of the
+        # achievable instead of a bare number.
+        if snap.get("probe_write_gbps"):
+            rec["probe_write_gbps"] = snap["probe_write_gbps"]
+        return rec
 
     # --- lifecycle ------------------------------------------------------
 
@@ -522,11 +528,18 @@ def render_watch_table(
         flag = ""
         if r.get("state") == "running" and age > stall_flag_s:
             flag = "  ** STALLED?"
+        # With in-take probes on, express live MB/s against the latest
+        # self-measured ceiling — "600 MB/s (31% of ceiling)" answers
+        # "is that slow?" without leaving the table.
+        ceiling = r.get("probe_write_gbps")
+        mbps = r.get("mbps", 0.0)
+        if ceiling and mbps:
+            flag = f"  ({min(mbps / (ceiling * 1e3), 9.99):.0%} of ceiling)" + flag
         lines.append(
             f"{r.get('rank', '?'):>4}  {r.get('state', '?'):<10} "
             f"{(r.get('phase') or '-'):<16.16} {(r.get('op') or '-'):<20.20} "
             f"{(f'{pct:.1f}' if pct is not None else '-'):>6} "
-            f"{r.get('mbps', 0.0):>8.1f} {age:>6.1f}s{flag}"
+            f"{mbps:>8.1f} {age:>6.1f}s{flag}"
         )
     if not records:
         lines.append("(no heartbeat records yet)")

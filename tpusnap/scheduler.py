@@ -232,6 +232,123 @@ class _Reporter:
             )
 
 
+class _ProbeRunner:
+    """In-take roofline probes (``TPUSNAP_PROBE=1``): between I/O
+    windows — once per TPUSNAP_PROBE_INTERVAL_BYTES of payload writes,
+    while no blob write is in flight — write (then read back, then
+    delete) TPUSNAP_PROBE_BYTES of raw data through the take's OWN
+    storage plugin stack, across a few concurrent streams, and record
+    the aggregate throughput as a probe sample. The take's summary
+    derives ``roofline_fraction`` from these samples: a ceiling
+    measured seconds (not minutes) from the writes it judges, immune to
+    the multi-minute disk drift that made separate full-scale roofline
+    sessions scatter 3x (ROADMAP 5a). Probe files live under
+    ``.tpusnap/probe/`` (journal-exempt sidecar space; a crash's
+    leftovers are orphan-visible to fsck/gc). Failures never fail the
+    take — a failed probe is one missing sample."""
+
+    _STREAMS = 4
+
+    def __init__(
+        self,
+        storage: StoragePlugin,
+        rank: int,
+        tele: telemetry.TakeTelemetry,
+    ) -> None:
+        from .knobs import get_probe_bytes, get_probe_interval_bytes
+
+        self.storage = storage
+        self.rank = rank
+        self.tele = tele
+        self.interval_bytes = get_probe_interval_bytes()
+        self.stream_bytes = max(get_probe_bytes() // self._STREAMS, 1 << 20)
+        self.bytes_since_probe = 0
+        self.ran = 0
+        self._buf: Optional[memoryview] = None
+        self._failed = False
+
+    @property
+    def due(self) -> bool:
+        return not self._failed and self.bytes_since_probe >= self.interval_bytes
+
+    def note_written(self, nbytes: int) -> None:
+        self.bytes_since_probe += nbytes
+
+    def _buffer(self) -> memoryview:
+        if self._buf is None:
+            # Random-ish payload (tiled 1 MiB urandom block): constant
+            # fill could be flattered by host-side image compression
+            # and would not match what the take writes.
+            block = _os.urandom(1 << 20)
+            reps = (self.stream_bytes + len(block) - 1) // len(block)
+            self._buf = memoryview(block * reps)[: self.stream_bytes]
+        return self._buf
+
+    def _path(self, i: int) -> str:
+        return f".tpusnap/probe/rank_{self.rank}_{i}.bin"
+
+    async def run(self) -> None:
+        """One probe segment. Caller guarantees no blob I/O in flight
+        (the scheduler parks its I/O gate until the window drains), so
+        the sample measures the engine, not contention with the take."""
+        self.bytes_since_probe = 0
+        start = self.tele.now()
+        nbytes = self.stream_bytes * self._STREAMS
+        try:
+            buf = self._buffer()
+            paths = [self._path(i) for i in range(self._STREAMS)]
+            t0 = time.monotonic()
+            await asyncio.gather(
+                *(self.storage.write(WriteIO(path=p, buf=buf)) for p in paths)
+            )
+            write_s = time.monotonic() - t0
+            t0 = time.monotonic()
+            await asyncio.gather(
+                *(self.storage.read(ReadIO(path=p)) for p in paths)
+            )
+            read_s = time.monotonic() - t0
+            await asyncio.gather(
+                *(self.storage.delete(p) for p in paths),
+                return_exceptions=True,
+            )
+        except Exception:
+            # One WARNING, then stand down for this take: a backend
+            # that cannot take probe traffic must not eat a retry storm.
+            # Best-effort cleanup of any stream that did land (a
+            # leftover would only be orphan debris for gc, but tidy is
+            # cheaper than debris).
+            self._failed = True
+            logger.warning(
+                "Rank %d: in-take roofline probe failed (non-fatal; "
+                "disabled for the rest of this take)",
+                self.rank,
+                exc_info=True,
+            )
+            try:
+                await asyncio.gather(
+                    *(
+                        self.storage.delete(self._path(i))
+                        for i in range(self._STREAMS)
+                    ),
+                    return_exceptions=True,
+                )
+            except Exception:
+                pass
+            return
+        elapsed = self.tele.now() - start
+        sample = {
+            "write_gbps": round(nbytes / max(write_s, 1e-9) / 1e9, 4),
+            "read_gbps": round(nbytes / max(read_s, 1e-9) / 1e9, 4),
+            "bytes": nbytes,
+            "elapsed_s": round(elapsed, 6),
+        }
+        self.ran += 1
+        self.tele.add_probe_sample(sample)
+        self.tele.record_span("probe_roofline", start, elapsed, **sample)
+        telemetry.incr("probe.probes", rec=self.tele)
+        telemetry.incr("probe.bytes_written", nbytes, rec=self.tele)
+
+
 @dataclass
 class PendingIOWork:
     """Work remaining after the blocked window closed (reference
@@ -450,10 +567,21 @@ class _WriteScheduler:
         stage_eagerly: Optional[Callable[[WriteReq], bool]] = None,
         tele: Optional[telemetry.TakeTelemetry] = None,
     ) -> None:
-        from .knobs import get_async_stage_window_bytes, get_stage_threads
+        from .knobs import (
+            get_async_stage_window_bytes,
+            get_stage_threads,
+            is_probe_enabled,
+        )
 
         self.storage = storage
         self.rank = rank
+        # In-take roofline probes: only with an enabled recorder (their
+        # whole output is telemetry) and the opt-in knob.
+        self.probe: Optional[_ProbeRunner] = (
+            _ProbeRunner(storage, rank, tele)
+            if tele is not None and tele.enabled and is_probe_enabled()
+            else None
+        )
         self.prioritize_staging = prioritize_staging
         self.pipelined = pipelined_staging
         self.tele = tele
@@ -601,13 +729,31 @@ class _WriteScheduler:
         # runnable) — write completions are the only budget source.
         return bool(self.pipelines and not self.staging_tasks)
 
+    def _probe_may_run(self) -> bool:
+        # NEVER inside a pipelined take's blocked window: a probe there
+        # would bill its I/O to async_blocked_s — the exact metric
+        # async_take exists to minimize and history --check gates.
+        # Probes wait for the background drain.
+        return self.probe is not None and self.probe.due and not self.blocked
+
     def _dispatch_io(self) -> None:
+        if self._probe_may_run():
+            # Park new blob I/O: the in-flight window drains, the loop
+            # runs the probe against an idle engine, then reopens.
+            return
         if not self._io_gate_open():
             return
         while self.ready_for_io and len(self.io_tasks) < _MAX_IO_CONCURRENCY:
             self.io_tasks.add(
                 asyncio.ensure_future(self.ready_for_io.pop(0).write())
             )
+
+    async def _maybe_probe(self) -> None:
+        """Run one due probe segment while no blob write is in flight
+        (the only moment a probe measures the engine, not contention).
+        Called before every I/O dispatch in the pump/drain loops."""
+        if self._probe_may_run() and not self.io_tasks:
+            await self.probe.run()
 
     def _update_reporter(self) -> None:
         self.reporter.stage_counts = {
@@ -737,11 +883,14 @@ class _WriteScheduler:
                     self.io_tasks.discard(task)
                     pipeline = task.result()
                     self.budget += pipeline.buf_size
+                    if self.probe is not None:
+                        self.probe.note_written(pipeline.buf_size)
                     self.reporter.report_request_done(pipeline.buf_size)
             # Staging first: the I/O gate must see the REFILLED staging
             # set, or it opens spuriously in the instant between one
             # stager finishing and the next starting.
             self._dispatch_staging()
+            await self._maybe_probe()
             self._dispatch_io()
             self._update_reporter()
         self._finish_staging()
@@ -779,6 +928,7 @@ class _WriteScheduler:
         try:
             await self._pump(stop_at_first_window=False)
             while self.io_tasks or self.ready_for_io:
+                await self._maybe_probe()
                 self._dispatch_io()
                 done, _ = await asyncio.wait(
                     self.io_tasks, return_when=asyncio.FIRST_COMPLETED
@@ -787,8 +937,20 @@ class _WriteScheduler:
                     self.io_tasks.discard(task)
                     pipeline = task.result()
                     self.budget += pipeline.buf_size
+                    if self.probe is not None:
+                        self.probe.note_written(pipeline.buf_size)
                     self.reporter.report_request_done(pipeline.buf_size)
                 self._update_reporter()
+            if (
+                self.probe is not None
+                and self.probe.ran == 0
+                and not self.probe._failed
+                and self.reporter.bytes_done > 0
+            ):
+                # A take smaller than the probe interval still gets ONE
+                # sample: "every take self-measures its ceiling" must
+                # not silently exclude small takes.
+                await self.probe.run()
         except BaseException:
             await self._abort()
             raise

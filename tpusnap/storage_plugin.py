@@ -21,10 +21,11 @@ Two middleware layers compose around the raw plugin:
 """
 
 import asyncio
+import time
 from importlib.metadata import entry_points
 from typing import Any, Dict, Optional
 
-from .io_types import StoragePlugin
+from .io_types import SIDECAR_PREFIX, ReadIO, StoragePlugin, WriteIO
 
 _ENTRY_POINT_GROUP = "tpusnap.storage_plugins"
 
@@ -86,6 +87,128 @@ def _resolve_raw_plugin(
     raise RuntimeError(f"Unsupported storage scheme: {scheme}:// ({path})")
 
 
+class InstrumentedStoragePlugin(StoragePlugin):
+    """Latency × size histogram instrumentation at the storage-plugin
+    boundary (:func:`tpusnap.telemetry.observe_io`): every successful
+    write/read/delete/list is timed on the monotonic clock and recorded
+    into the process-global AND in-flight take's log2 histograms, keyed
+    by ``<op>.<PluginClass>`` of the plugin it measures (the innermost
+    raw backend, unwrapped through middleware). Composed INSIDE the
+    retry middleware so each attempt is one sample — p99 means "p99 of
+    actual backend ops", not "p99 including backoff sleeps" — and
+    OUTSIDE the chaos layer so injected latency/stalls show up as the
+    fat tails they are. Failures are not sampled (a raised write has no
+    defensible latency). Everything else delegates to the wrapped
+    plugin; unknown attributes pass through."""
+
+    def __init__(self, inner: StoragePlugin) -> None:
+        self.inner = inner
+        base = inner
+        while hasattr(base, "inner") and isinstance(
+            getattr(base, "inner"), StoragePlugin
+        ):
+            base = base.inner
+        self.label = type(base).__name__
+
+    # --- attribute passthrough ----------------------------------------
+    # ABC-defined attrs/methods never reach __getattr__; delegate them
+    # explicitly so registry logic and the scheduler see the inner
+    # plugin's capabilities.
+
+    def __getattr__(self, name: str) -> Any:
+        if name == "inner":
+            # Only reachable when self.inner was never set (e.g. during
+            # copy/unpickle protocols) — delegating would recurse.
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    @property
+    def supports_in_place_reads(self) -> bool:  # type: ignore[override]
+        return self.inner.supports_in_place_reads
+
+    @property
+    def wants_retry_middleware(self) -> bool:  # type: ignore[override]
+        return self.inner.wants_retry_middleware
+
+    @property
+    def handles_own_retries(self) -> bool:  # type: ignore[override]
+        return self.inner.handles_own_retries
+
+    def classify_transient(self, exc: BaseException) -> bool:
+        return self.inner.classify_transient(exc)
+
+    def in_place_read_overhead_bytes(self, nbytes: int) -> int:
+        return self.inner.in_place_read_overhead_bytes(nbytes)
+
+    def drain_in_flight(self) -> None:
+        self.inner.drain_in_flight()
+
+    # --- instrumented ops ---------------------------------------------
+
+    # Sidecar/probe traffic (telemetry traces, heartbeats, journal
+    # records, roofline probe streams) is NOT sampled: the histograms
+    # gate PAYLOAD I/O tails (analyze --check, history's
+    # storage_write_p99_s), and a stream of small fast sidecar writes —
+    # or 16 MiB probe segments 32x faster than 512 MiB blob writes —
+    # would drag p50 down and fire the p99/p50 gate on a healthy disk.
+    _UNSAMPLED_PREFIX = SIDECAR_PREFIX
+
+    def _observe(self, op: str, path: str, t0: float, nbytes: int) -> None:
+        if path.startswith(self._UNSAMPLED_PREFIX):
+            return
+        from . import telemetry
+
+        try:
+            telemetry.observe_io(
+                op, self.label, time.monotonic() - t0, nbytes
+            )
+        except Exception:
+            pass  # telemetry never fails an op
+
+    async def write(self, write_io: WriteIO) -> None:
+        t0 = time.monotonic()
+        await self.inner.write(write_io)
+        self._observe("write", write_io.path, t0, len(write_io.buf))
+
+    async def write_atomic(self, write_io: WriteIO, durable: bool = False) -> None:
+        t0 = time.monotonic()
+        await self.inner.write_atomic(write_io, durable=durable)
+        self._observe("write", write_io.path, t0, len(write_io.buf))
+
+    @staticmethod
+    def _read_nbytes(read_io: ReadIO) -> int:
+        if read_io.byte_range is not None:
+            return int(read_io.byte_range[1] - read_io.byte_range[0])
+        if read_io.in_place and read_io.into is not None:
+            return memoryview(read_io.into).nbytes
+        try:
+            return read_io.buf.getbuffer().nbytes
+        except Exception:
+            return 0
+
+    async def read(self, read_io: ReadIO) -> None:
+        t0 = time.monotonic()
+        await self.inner.read(read_io)
+        self._observe("read", read_io.path, t0, self._read_nbytes(read_io))
+
+    async def delete(self, path: str) -> None:
+        t0 = time.monotonic()
+        await self.inner.delete(path)
+        self._observe("delete", path, t0, 0)
+
+    async def list_with_sizes(self) -> Optional[dict]:
+        t0 = time.monotonic()
+        out = await self.inner.list_with_sizes()
+        self._observe("list", "", t0, 0)
+        return out
+
+    async def flush_created_dirs(self) -> None:
+        await self.inner.flush_created_dirs()
+
+    async def close(self) -> None:
+        await self.inner.close()
+
+
 def url_to_storage_plugin(
     url_path: str, storage_options: Optional[Dict[str, Any]] = None
 ) -> StoragePlugin:
@@ -112,6 +235,15 @@ def url_to_storage_plugin(
 
         plan = FaultPlan.coerce((storage_options or {}).get("fault_plan"))
         plugin = FaultInjectionStoragePlugin(plugin, plan)
+
+    # I/O histogram instrumentation: INSIDE retry (per-attempt samples,
+    # no backoff sleeps in the latency), OUTSIDE chaos (injected
+    # latency/stalls are exactly the tails the histograms exist to
+    # expose). Runtime-registered plugins are returned as built — same
+    # stance as the retry middleware — unless chaos composed around
+    # them (the composition is then already not "as built").
+    if chaos or not from_runtime_registry:
+        plugin = InstrumentedStoragePlugin(plugin)
 
     wants_retry = chaos or (
         not from_runtime_registry
