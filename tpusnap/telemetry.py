@@ -16,6 +16,16 @@ rank. This module is that instrument:
   ``dict`` add).
 - **Gauges** — high-water marks (scheduler budget in use, peak RSS
   delta sampled by :mod:`tpusnap.rss_profiler`).
+- **I/O histograms** — always-on log2-bucketed latency × size
+  histograms per ``(op, plugin class)`` at the storage-plugin boundary
+  (:class:`LogHistogram`/:class:`IOStats`, fed by the registry's
+  instrumentation wrapper): p50/p95/p99/max derivable from any
+  cross-rank merge, recorded per rank and folded into the rollup —
+  whole-op spans hide tail latency; these are where it lives.
+- **Roofline probes** — opt-in (``TPUSNAP_PROBE=1``) in-take probe
+  segments the write scheduler interleaves between I/O windows; their
+  samples land here and the summary derives a drift-immune
+  ``roofline_fraction`` (see :mod:`tpusnap.analyze`).
 - **TakeTelemetry** — the per-take aggregate. One is installed
   process-globally for the duration of a take (background drain
   threads re-install it thread-locally via :func:`use`); module-level
@@ -44,6 +54,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import threading
 import time
 from contextlib import contextmanager
@@ -191,6 +202,224 @@ def reset_global_counters() -> None:
         _global_counters.clear()
 
 
+# ----------------------------------------------------- I/O histograms
+
+# Bucket key for non-positive observations (a zero-latency op, an empty
+# write): kept separate so quantile math never takes log2(0).
+_ZERO_BUCKET = -1074  # below the smallest positive float64 exponent
+
+
+class LogHistogram:
+    """log2-bucketed histogram: observation ``v`` lands in bucket
+    ``floor(log2 v)`` (i.e. the half-open interval ``[2^k, 2^(k+1))``),
+    so the whole dynamic range of I/O latencies (microseconds to
+    minutes) and sizes (bytes to gigabytes) fits in a few dozen integer
+    buckets with bounded relative error. Tracks exact count/sum/min/max
+    alongside, so ``quantile(1.0)`` is the true max and single-sample
+    histograms are exact. Mergeable across ranks (bucket-count sums) —
+    the property the cross-rank rollup and the trend gates rely on;
+    p50/p95/p99 are derivable from any merge. NOT thread-safe on its
+    own; callers hold their registry lock."""
+
+    __slots__ = ("buckets", "count", "total", "vmin", "vmax")
+
+    def __init__(self) -> None:
+        self.buckets: Dict[int, int] = {}
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        if v > 0.0:
+            # floor(log2 v) == frexp exponent - 1 (v = m * 2^e, m in
+            # [0.5, 1)) — no log call, exact at bucket boundaries.
+            k = math.frexp(v)[1] - 1
+        else:
+            v = 0.0
+            k = _ZERO_BUCKET
+        self.buckets[k] = self.buckets.get(k, 0) + 1
+        self.count += 1
+        self.total += v
+        if v < self.vmin:
+            self.vmin = v
+        if v > self.vmax:
+            self.vmax = v
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Value at quantile ``q`` in [0, 1]: geometrically interpolated
+        within the bucket holding the q-th observation (rank position
+        maps to an exponent fraction, so the estimate moves CONTINUOUSLY
+        as mass shifts across a bucket boundary — a gated p99 must not
+        jump 2x when the true latency drifts 10% across a power of
+        two), clamped into the exact observed [min, max]. Exact for max
+        and for single-sample histograms (a lone sample interpolates to
+        its bucket's upper edge, which the clamp pins to the sample)."""
+        if self.count == 0:
+            return None
+        if q >= 1.0:
+            return self.vmax
+        target = q * self.count
+        cum = 0
+        for k in sorted(self.buckets):
+            n = self.buckets[k]
+            cum += n
+            if cum >= target:
+                if k == _ZERO_BUCKET:
+                    return 0.0
+                frac = (target - (cum - n)) / n
+                est = math.ldexp(1.0, k) * (2.0 ** frac)
+                return max(min(est, self.vmax), self.vmin)
+        return self.vmax
+
+    def merge(self, other: "LogHistogram") -> None:
+        for k, n in other.buckets.items():
+            self.buckets[k] = self.buckets.get(k, 0) + n
+        self.count += other.count
+        self.total += other.total
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "total": self.total,
+            "min": self.vmin if self.count else None,
+            "max": self.vmax,
+            "buckets": {str(k): n for k, n in sorted(self.buckets.items())},
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "LogHistogram":
+        h = cls()
+        for k, n in (d.get("buckets") or {}).items():
+            h.buckets[int(k)] = int(n)
+        h.count = int(d.get("count", 0))
+        h.total = float(d.get("total", 0.0))
+        h.vmin = float(d["min"]) if d.get("min") is not None else math.inf
+        h.vmax = float(d.get("max", 0.0))
+        return h
+
+
+class IOStats:
+    """Latency × size histogram pair for one (op, plugin-class) key at
+    the storage-plugin boundary: per-op latency in seconds and payload
+    size in bytes, each log2-bucketed, plus the derived quantiles the
+    doctor CLI and the regression gates read."""
+
+    __slots__ = ("latency", "size")
+
+    def __init__(self) -> None:
+        self.latency = LogHistogram()
+        self.size = LogHistogram()
+
+    def observe(self, seconds: float, nbytes: int) -> None:
+        self.latency.observe(seconds)
+        self.size.observe(nbytes)
+
+    def merge_dict(self, d: Dict[str, Any]) -> None:
+        if "latency" in d:
+            self.latency.merge(LogHistogram.from_dict(d["latency"]))
+        if "size" in d:
+            self.size.merge(LogHistogram.from_dict(d["size"]))
+
+    def to_dict(self) -> Dict[str, Any]:
+        lat = self.latency
+        out: Dict[str, Any] = {
+            "count": lat.count,
+            "total_s": round(lat.total, 6),
+            "bytes_total": int(self.size.total),
+            "latency": lat.to_dict(),
+            "size": self.size.to_dict(),
+        }
+        for name, q in (("p50_s", 0.5), ("p95_s", 0.95), ("p99_s", 0.99)):
+            v = lat.quantile(q)
+            out[name] = round(v, 9) if v is not None else None
+        out["max_s"] = round(lat.vmax, 9) if lat.count else None
+        return out
+
+
+# Process-lifetime I/O histograms, knob-independent like the counters:
+# one IOStats per "<op>.<PluginClass>" key ("write.FSStoragePlugin").
+# The Prometheus sink exports quantiles from THIS registry (stable
+# across takes); per-take copies ride TakeTelemetry and the rollup.
+_global_io_stats: Dict[str, IOStats] = {}
+_io_stats_lock = threading.Lock()
+
+
+def observe_io(
+    op: str,
+    plugin: str,
+    seconds: float,
+    nbytes: int,
+    rec: Optional["TakeTelemetry"] = None,
+) -> None:
+    """Record one storage-plugin op (write/read/delete/list) into the
+    process-global histograms AND the in-flight take/restore recorder
+    (the ambient one, or an explicit ``rec``). Always-on: the cost is
+    two dict updates per multi-MB I/O op."""
+    key = f"{op}.{plugin}"
+    with _io_stats_lock:
+        st = _global_io_stats.get(key)
+        if st is None:
+            st = _global_io_stats[key] = IOStats()
+        st.observe(seconds, nbytes)
+    rec = rec if rec is not None else current()
+    if rec is not None:
+        rec.observe_io(key, seconds, nbytes)
+
+
+def global_io_histograms_snapshot() -> Dict[str, Dict[str, Any]]:
+    """Serialized copy of the process-lifetime I/O histograms (the
+    monotonic domain the Prometheus sink exports quantiles from)."""
+    with _io_stats_lock:
+        return {k: v.to_dict() for k, v in sorted(_global_io_stats.items())}
+
+
+def reset_global_io_histograms() -> None:
+    """Test aid; production code never resets."""
+    with _io_stats_lock:
+        _global_io_stats.clear()
+
+
+def probe_aggregate(samples: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold in-take roofline probe samples into the compact aggregate
+    the summary/rollup/history carry: sample count, p50 of the per-probe
+    write/read ceilings, total probe bytes and elapsed time."""
+
+    def _p50(key: str) -> Optional[float]:
+        vals = sorted(s[key] for s in samples if s.get(key))
+        return round(vals[len(vals) // 2], 4) if vals else None
+
+    return {
+        "probes": len(samples),
+        "write_gbps_p50": _p50("write_gbps"),
+        "read_gbps_p50": _p50("read_gbps"),
+        "bytes": int(sum(s.get("bytes", 0) for s in samples)),
+        "elapsed_s": round(sum(s.get("elapsed_s", 0.0) for s in samples), 6),
+    }
+
+
+def merge_io_histograms(
+    dicts: List[Dict[str, Dict[str, Any]]],
+) -> Dict[str, Dict[str, Any]]:
+    """Merge serialized per-rank ``io_histograms`` maps (bucket-count
+    sums per key) — the cross-rank rollup's histogram fold. Quantiles
+    are recomputed from the merged buckets."""
+    merged: Dict[str, IOStats] = {}
+    for d in dicts:
+        for key, st_dict in (d or {}).items():
+            st = merged.get(key)
+            if st is None:
+                st = merged[key] = IOStats()
+            try:
+                st.merge_dict(st_dict)
+            except Exception:
+                continue
+    return {k: v.to_dict() for k, v in sorted(merged.items())}
+
+
 # ------------------------------------------------------- TakeTelemetry
 
 
@@ -220,6 +449,10 @@ class TakeTelemetry:
         self._events: List[Tuple[str, float, str, Dict[str, Any]]] = []
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
+        # Per-take I/O histograms ("<op>.<PluginClass>" → IOStats) and
+        # in-take roofline probe samples — always-on like the counters.
+        self._io_hist: Dict[str, IOStats] = {}
+        self._probe_samples: List[Dict[str, Any]] = []
         self._finalized_wall_s: Optional[float] = None
         # Live state for the heartbeat/watchdog (tpusnap.progress):
         # in-flight named ops keyed by an opaque token (an op may span
@@ -317,12 +550,22 @@ class TakeTelemetry:
             ops = list(self._inflight.values())
             counters = dict(self._counters)
             marks = len(self._spans) + len(self._events)
-        return {
+            probe_gbps = (
+                self._probe_samples[-1].get("write_gbps")
+                if self._probe_samples
+                else None
+            )
+        out = {
             "phase": self._last_phase,
             "ops": ops,
             "counters": counters,
             "marks": marks,
         }
+        if probe_gbps:
+            # Latest in-take probe ceiling: lets the heartbeat/watch
+            # table express live MB/s as a fraction of the achievable.
+            out["probe_write_gbps"] = round(probe_gbps, 3)
+        return out
 
     def event(self, name: str, **attrs: Any) -> None:
         if not self.enabled:
@@ -342,6 +585,20 @@ class TakeTelemetry:
         with self._lock:
             if value > self._gauges.get(name, float("-inf")):
                 self._gauges[name] = value
+
+    def observe_io(self, key: str, seconds: float, nbytes: int) -> None:
+        """Take-local leg of :func:`observe_io` (always-on)."""
+        with self._lock:
+            st = self._io_hist.get(key)
+            if st is None:
+                st = self._io_hist[key] = IOStats()
+            st.observe(seconds, nbytes)
+
+    def add_probe_sample(self, sample: Dict[str, Any]) -> None:
+        """Record one in-take roofline probe result (scheduler's probe
+        runner): ``write_gbps``/``read_gbps``/``bytes``/``elapsed_s``."""
+        with self._lock:
+            self._probe_samples.append(dict(sample))
 
     # --- finalization ---------------------------------------------------
 
@@ -380,6 +637,8 @@ class TakeTelemetry:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             events = list(self._events)
+            io_hist = {k: v.to_dict() for k, v in sorted(self._io_hist.items())}
+            probes = [dict(s) for s in self._probe_samples]
         by_name: Dict[str, List[float]] = {}
         phase_total: Dict[str, float] = {}
         for name, _start, dur, _thread, phase, _attrs in spans:
@@ -397,7 +656,7 @@ class TakeTelemetry:
             }
         take_wall = self.take_wall_s
         phase_sum = sum(phase_total.values())
-        return {
+        out = {
             **self.meta,
             "rank": self.rank,
             "enabled": self.enabled,
@@ -412,6 +671,23 @@ class TakeTelemetry:
             "gauges": gauges,
             "events": len(events),
         }
+        if io_hist:
+            out["io_histograms"] = io_hist
+        if probes:
+            out["probe"] = probe_aggregate(probes)
+            # Drift-immune in-take roofline fraction: the take's payload
+            # throughput over its NON-PROBE wall-clock, against the
+            # ceiling the interleaved probes measured through the same
+            # engine moments apart — no separate roofline session whose
+            # disk window the take never shared.
+            ceiling = out["probe"].get("write_gbps_p50")
+            payload = counters.get("storage.bytes_written", 0)
+            adj_wall = max(take_wall - out["probe"].get("elapsed_s", 0.0), 1e-9)
+            if ceiling and payload:
+                out["roofline_fraction"] = round(
+                    (payload / adj_wall / 1e9) / ceiling, 4
+                )
+        return out
 
     def chrome_trace_events(self) -> List[Dict[str, Any]]:
         """Chrome trace-event list: complete ("X") events for spans,
@@ -695,7 +971,7 @@ def rollup_summaries(summaries: List[Dict[str, Any]]) -> Dict[str, Any]:
             "max_rank": ts[-1][1],
             "skew": round(mx / p50, 3) if p50 > 0 else None,
         }
-    return {
+    out = {
         "phase_skew": phase_skew,
         "ranks": len(summaries),
         "take_wall_s": round(max(s.get("take_wall_s", 0.0) for s in summaries), 6),
@@ -710,3 +986,37 @@ def rollup_summaries(summaries: List[Dict[str, Any]]) -> Dict[str, Any]:
         "budget_high_water_bytes": gauges.get("scheduler.budget_used_bytes"),
         "peak_rss_delta_bytes": gauges.get("peak_rss_delta_bytes"),
     }
+    # Cross-rank I/O histogram merge: bucket-count sums per
+    # "<op>.<PluginClass>" key, quantiles recomputed from the merge —
+    # a rank's p99 outlier survives the fold instead of averaging away.
+    io_merged = merge_io_histograms(
+        [s.get("io_histograms") or {} for s in summaries]
+    )
+    if io_merged:
+        out["io_histograms"] = io_merged
+    # In-take roofline probes: the p50 fraction across ranks (the fleet
+    # headline) plus the worst rank's, with its id (a single rank's slow
+    # disk is a straggler story, not a fleet story).
+    fracs = sorted(
+        (s["roofline_fraction"], s.get("rank", i))
+        for i, s in enumerate(summaries)
+        if isinstance(s.get("roofline_fraction"), (int, float))
+    )
+    if fracs:
+        out["roofline_fraction"] = round(fracs[len(fracs) // 2][0], 4)
+        out["roofline_fraction_min"] = round(fracs[0][0], 4)
+        out["roofline_fraction_min_rank"] = fracs[0][1]
+        probe_ranks = [s["probe"] for s in summaries if s.get("probe")]
+        if probe_ranks:
+            ceilings = sorted(
+                p["write_gbps_p50"]
+                for p in probe_ranks
+                if p.get("write_gbps_p50")
+            )
+            out["probe"] = {
+                "probes": sum(p.get("probes", 0) for p in probe_ranks),
+                "write_gbps_p50": (
+                    round(ceilings[len(ceilings) // 2], 4) if ceilings else None
+                ),
+            }
+    return out
