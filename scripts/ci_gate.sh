@@ -1,21 +1,27 @@
 #!/usr/bin/env bash
 # One-entrypoint CI/cron gate for tpusnap:
 #
-#   1. tier-1 tests (the ROADMAP.md verify command)
-#   2. `tpusnap history --check` — cross-run regression gate on this
+#   1. `tpusnap lint --check` — AST invariant checker over the package
+#      (knob access, monotonic clocks, sidecar constants, silent
+#      swallows, async blocking calls, finalizer joins, knob/doc
+#      drift); runs first because it is the cheapest gate
+#   2. tier-1 tests (the ROADMAP.md verify command), run with
+#      TPUSNAP_LOCKCHECK=1 by conftest — any lock-order cycle fails
+#      the session
+#   3. `tpusnap history --check` — cross-run regression gate on this
 #      host's history.jsonl: take throughput AND p99 storage-write
 #      latency (insufficient history — exit 3 — passes, so a fresh
 #      host bootstraps instead of failing forever)
-#   3. `tpusnap analyze --check` — performance doctor on the newest
+#   4. `tpusnap analyze --check` — performance doctor on the newest
 #      bench/CI snapshot (tail latency, stragglers, roofline), when
 #      one is available
 #
 # Usage:
 #   scripts/ci_gate.sh [SNAPSHOT_PATH]
 #
-#   SNAPSHOT_PATH        snapshot for step 3 (default: $TPUSNAP_CI_SNAPSHOT,
-#                        else step 3 is skipped with a note)
-#   TPUSNAP_CI_SKIP_TESTS=1   skip step 1 (cron boxes that only gate
+#   SNAPSHOT_PATH        snapshot for step 4 (default: $TPUSNAP_CI_SNAPSHOT,
+#                        else step 4 is skipped with a note)
+#   TPUSNAP_CI_SKIP_TESTS=1   skip step 2 (cron boxes that only gate
 #                             perf trends, not code)
 #
 # Exit: non-zero on the first failing gate, echoing which one.
@@ -26,9 +32,15 @@ cd "$(dirname "$0")/.."
 
 fail() { echo "ci_gate: FAIL — $1" >&2; exit "$2"; }
 
-# ---- 1. tier-1 -----------------------------------------------------------
+# ---- 1. static analysis --------------------------------------------------
+echo "ci_gate: [1/4] lint --check (AST invariants)"
+env JAX_PLATFORMS=cpu python -m tpusnap lint --check
+rc=$?
+[ "$rc" -eq 0 ] || fail "tpusnap lint --check (rc=$rc)" "$rc"
+
+# ---- 2. tier-1 -----------------------------------------------------------
 if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
-    echo "ci_gate: [1/3] tier-1 tests"
+    echo "ci_gate: [2/4] tier-1 tests"
     rm -f /tmp/_t1.log
     timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m 'not slow' --continue-on-collection-errors \
@@ -37,11 +49,11 @@ if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
     echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
     [ "$rc" -eq 0 ] || fail "tier-1 tests (rc=$rc)" "$rc"
 else
-    echo "ci_gate: [1/3] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
+    echo "ci_gate: [2/4] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
 fi
 
-# ---- 2. cross-run history gate ------------------------------------------
-echo "ci_gate: [2/3] history --check (throughput + p99 write latency)"
+# ---- 3. cross-run history gate ------------------------------------------
+echo "ci_gate: [3/4] history --check (throughput + p99 write latency)"
 for kind in take bench; do
     python -m tpusnap history --check --kind "$kind" \
         --metric throughput_gbps --metric storage_write_p99_s --json
@@ -53,10 +65,10 @@ for kind in take bench; do
     esac
 done
 
-# ---- 3. analyze doctor on the latest snapshot ---------------------------
+# ---- 4. analyze doctor on the latest snapshot ---------------------------
 SNAP="${1:-${TPUSNAP_CI_SNAPSHOT:-}}"
 if [ -n "$SNAP" ]; then
-    echo "ci_gate: [3/3] analyze --check $SNAP"
+    echo "ci_gate: [4/4] analyze --check $SNAP"
     python -m tpusnap analyze --check --history "$SNAP"
     rc=$?
     case "$rc" in
@@ -65,7 +77,7 @@ if [ -n "$SNAP" ]; then
         *) fail "analyze --check $SNAP (rc=$rc)" "$rc" ;;
     esac
 else
-    echo "ci_gate: [3/3] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
+    echo "ci_gate: [4/4] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
 fi
 
 echo "ci_gate: PASS"

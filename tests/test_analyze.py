@@ -342,10 +342,15 @@ def test_probe_runner_stands_down_after_failure():
 
     with override_probe(True, interval_bytes=1 << 20, probe_bytes=1 << 20):
         tele = telemetry.TakeTelemetry(rank=0, enabled=True)
-        runner = _ProbeRunner(BoomPlugin(), rank=0, tele=tele)
-        runner.note_written(1 << 30)
-        assert runner.due
-        asyncio.run(runner.run())
+        try:
+            runner = _ProbeRunner(BoomPlugin(), rank=0, tele=tele)
+            runner.note_written(1 << 30)
+            assert runner.due
+            asyncio.run(runner.run())
+        finally:
+            # A bare TakeTelemetry (no end_take) starts an RSS sampler
+            # thread; stop it or it outlives the test forever.
+            tele.finalize()
     assert runner.ran == 0
     assert runner._failed
     runner.note_written(1 << 30)

@@ -63,7 +63,12 @@ class TestRSSProfiler:
     def test_samples_collected(self):
         deltas = []
         with measure_rss_deltas(deltas, interval_sec=0.01):
-            buf = np.ones(2_000_000)  # ~16MB
+            # ~72MB: above glibc's maximum dynamic mmap threshold
+            # (32 MiB), so the buffer is always freshly mmapped and the
+            # RSS delta is visible even late in a long suite — a 16MB
+            # allocation can be served from a recycled arena with zero
+            # RSS movement.
+            buf = np.ones(9_000_000)
             time.sleep(0.05)
             del buf
         assert len(deltas) >= 2

@@ -1,75 +1,34 @@
-"""Doc/code drift guards for the observability surface:
+"""Doc/code drift guards for the observability surface — now thin
+wrappers over the lint engine so there is ONE rule implementation, not
+three ad-hoc greps:
 
-1. Knob drift — every ``TPUSNAP_*`` env var defined in tpusnap/knobs.py
-   must appear in docs/api.md, and every knob row in api.md's knob
-   table must be referenced somewhere in the package source. Fails
-   naming the missing knobs (the acceptance criterion of the fleet
-   observability PR's doc-drift satellite).
-2. Monotonic-only lint — ``time.time()`` calls are forbidden in
-   tpusnap/telemetry.py, tpusnap/progress.py and tpusnap/history.py:
-   duration/throttle math in those files must run on the monotonic
-   clock (PR 2's invariant), and wall-clock TIMESTAMPS must go through
-   each module's injectable ``_wall``/``wall_clock`` seam (a bare
-   ``time.time`` reference, never a direct call) so fake-clock tests
-   stay possible and a copy-pasted ``time.time()`` in duration math is
-   caught by grep, not by a flaky 2 a.m. incident.
-"""
+1. Knob drift (TPS007, ``tpusnap/devtools/rules/tps007_knob_docs.py``) —
+   every ``TPUSNAP_*`` env var defined in tpusnap/knobs.py must appear
+   in docs/api.md, and every knob row in api.md's table must be
+   referenced somewhere in the package source.
+2. Monotonic-only clocks (TPS002, ``rules/tps002_monotonic.py``) —
+   direct wall-clock CALLS are forbidden in telemetry/progress/history;
+   timestamps ride each module's injectable ``_wall`` seam (a bare
+   ``time.time`` reference). The AST rule also catches the aliased
+   imports (``from time import time``) the original grep missed.
 
-import glob
-import os
-import re
+Kept as named tests (not just the whole-package gate in test_lint.py)
+so a drift failure points at the invariant by name."""
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tpusnap.devtools.lint import render_table, run_lint
 
 
-def _read(*parts):
-    with open(os.path.join(REPO, *parts)) as f:
-        return f.read()
+def _run_rule(rule_id):
+    result = run_lint(select=[rule_id])
+    assert result.rules_run == [rule_id]
+    return result
 
 
-def test_every_knob_in_knobs_py_is_documented():
-    defined = set(
-        re.findall(r'"(TPUSNAP_[A-Z0-9_]+)"', _read("tpusnap", "knobs.py"))
-    )
-    assert defined, "no knobs found — did knobs.py move?"
-    docs = _read("docs", "api.md")
-    missing = sorted(n for n in defined if n not in docs)
-    assert not missing, (
-        "knobs defined in tpusnap/knobs.py but undocumented in "
-        f"docs/api.md: {missing}"
-    )
+def test_knob_doc_drift_tps007():
+    result = _run_rule("TPS007")
+    assert result.findings == [], "\n" + render_table(result)
 
 
-def test_every_documented_knob_exists_in_source():
-    docs = _read("docs", "api.md")
-    table_rows = re.findall(r"^\|\s*`(TPUSNAP_[A-Z0-9_]+)`", docs, re.M)
-    assert table_rows, "no knob table rows found — did api.md move?"
-    source = "".join(
-        _read(p)
-        for p in glob.glob(
-            os.path.join(REPO, "tpusnap", "**", "*.py"), recursive=True
-        )
-    )
-    missing = sorted(n for n in set(table_rows) if n not in source)
-    assert not missing, (
-        "knobs documented in docs/api.md but referenced nowhere in "
-        f"tpusnap/: {missing}"
-    )
-
-
-def test_monotonic_only_no_time_time_calls():
-    offenders = {}
-    for name in ("telemetry.py", "progress.py", "history.py"):
-        src = _read("tpusnap", name)
-        lines = [
-            i
-            for i, ln in enumerate(src.splitlines(), 1)
-            if "time.time()" in ln
-        ]
-        if lines:
-            offenders[name] = lines
-    assert not offenders, (
-        f"direct time.time() calls in monotonic-only modules {offenders}: "
-        "durations must use time.monotonic(); wall timestamps must go "
-        "through the module's injectable _wall / wall_clock seam"
-    )
+def test_monotonic_only_clocks_tps002():
+    result = _run_rule("TPS002")
+    assert result.findings == [], "\n" + render_table(result)

@@ -1,0 +1,258 @@
+"""The runtime lock-order watchdog (``tpusnap.devtools.lockwatch``):
+cycle detection on a deliberate AB/BA pattern across two threads (the
+PR 6 deadlock shape), trylock semantics, RLock re-entry, held-across-
+I/O notes, and the global ``threading.Lock`` patch's compatibility with
+the stdlib synchronization primitives the package leans on.
+
+The synthetic-cycle tests use a PRIVATE :class:`LockOrderWatch` over
+``raw_lock()`` primitives so the session-global graph (tier-1 runs with
+``TPUSNAP_LOCKCHECK=1`` and fails on any cycle) stays clean."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from tpusnap.devtools import lockwatch
+from tpusnap.devtools.lockwatch import LockOrderWatch
+
+
+def _run_in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_ab_ba_cycle_two_threads_names_locks_and_sites():
+    """The acceptance shape: two threads acquire two locks in opposite
+    orders (sequentially — the graph records POTENTIAL deadlocks, no
+    lucky schedule needed) and the cycle report names both locks and
+    both acquisition sites."""
+    watch = LockOrderWatch()
+    lock_a = watch.wrap(lockwatch.raw_lock(), "A")
+    lock_b = watch.wrap(lockwatch.raw_lock(), "B")
+
+    def thread_one():
+        with lock_a:
+            with lock_b:  # A -> B
+                pass
+
+    def thread_two():
+        with lock_b:
+            with lock_a:  # B -> A
+                pass
+
+    _run_in_thread(thread_one)
+    _run_in_thread(thread_two)
+
+    cycles = watch.cycles()
+    assert len(cycles) == 1
+    assert set(cycles[0]["locks"]) == {"A", "B"}
+    # Both edges carry held-at/acquired-at evidence from THIS file.
+    for edge in cycles[0]["edges"]:
+        assert "test_lockwatch.py:" in edge["held_at"]
+        assert "test_lockwatch.py:" in edge["acquired_at"]
+    rendered = watch.render()
+    assert "CYCLE" in rendered and "A" in rendered and "B" in rendered
+
+
+def test_consistent_order_is_not_a_cycle():
+    watch = LockOrderWatch()
+    lock_a = watch.wrap(lockwatch.raw_lock(), "A")
+    lock_b = watch.wrap(lockwatch.raw_lock(), "B")
+    for _ in range(3):
+        with lock_a:
+            with lock_b:
+                pass
+    assert watch.cycles() == []
+    assert watch.report()["edges"] == 1
+
+
+def test_three_lock_cycle_detected():
+    """Longer cycles (A→B→C→A) are potential deadlocks too — the SCC
+    pass catches what a pairwise AB/BA scan would miss."""
+    watch = LockOrderWatch()
+    locks = {n: watch.wrap(lockwatch.raw_lock(), n) for n in "ABC"}
+    for first, second in [("A", "B"), ("B", "C"), ("C", "A")]:
+        with locks[first]:
+            with locks[second]:
+                pass
+    cycles = watch.cycles()
+    assert len(cycles) == 1
+    assert set(cycles[0]["locks"]) == {"A", "B", "C"}
+
+
+def test_trylock_adds_no_order_edge():
+    """A non-blocking acquire cannot wait, so it cannot deadlock: no
+    edge (lockdep's trylock rule) — but the lock still joins the held
+    stack, so locks acquired UNDER it do edge from it."""
+    watch = LockOrderWatch()
+    lock_a = watch.wrap(lockwatch.raw_lock(), "A")
+    lock_b = watch.wrap(lockwatch.raw_lock(), "B")
+    with lock_a:
+        assert lock_b.acquire(blocking=False)  # no A -> B edge
+        lock_b.release()
+    assert watch.report()["edges"] == 0
+    # ...but a blocking acquire under a trylock still records.
+    assert lock_a.acquire(blocking=False)
+    with lock_b:  # A -> B via blocking acquire under held trylock
+        pass
+    lock_a.release()
+    assert watch.report()["edges"] == 1
+
+
+def test_rlock_reentry_is_one_hold():
+    watch = LockOrderWatch()
+    rlock = watch.wrap(lockwatch.raw_rlock(), "R")
+    other = watch.wrap(lockwatch.raw_lock(), "L")
+    with rlock:
+        with rlock:  # re-entry: no self-edge, still one held entry
+            with other:
+                pass
+    report = watch.report()
+    assert report["edges"] == 1  # R -> L only
+    assert watch.cycles() == []
+    assert report["nested_same_site"] == {}
+
+
+def test_io_hold_recorded_with_site_and_count():
+    watch = LockOrderWatch()
+    lock_a = watch.wrap(lockwatch.raw_lock(), "A")
+    with lock_a:
+        watch.note_blocking("storage_write")
+        watch.note_blocking("storage_write")
+    watch.note_blocking("storage_write")  # nothing held: not recorded
+    holds = watch.report()["io_holds"]
+    assert len(holds) == 1
+    assert holds[0]["lock"] == "A"
+    assert holds[0]["tag"] == "storage_write"
+    assert holds[0]["count"] == 2
+    assert "test_lockwatch.py:" in holds[0]["held_at"]
+
+
+def test_wrap_dispatches_lock_vs_rlock():
+    watch = LockOrderWatch()
+    assert isinstance(
+        watch.wrap(lockwatch.raw_lock(), "l"), lockwatch.TrackedLock
+    )
+    assert isinstance(
+        watch.wrap(lockwatch.raw_rlock(), "r"), lockwatch.TrackedRLock
+    )
+
+
+# ------------------------------------------------- global install patch
+
+
+@pytest.fixture()
+def global_watch():
+    """The session's active watch (tier-1 runs with TPUSNAP_LOCKCHECK=1
+    installed by conftest/package import); installs a temporary one if
+    the suite was launched with lockcheck disabled."""
+    watch = lockwatch.active_watch()
+    if watch is not None:
+        yield watch
+        return
+    watch = lockwatch.install()
+    try:
+        yield watch
+    finally:
+        lockwatch.uninstall()
+
+
+def test_threading_lock_is_tracked_and_edges_recorded(global_watch):
+    lock_a = threading.Lock()
+    lock_b = threading.RLock()
+    assert isinstance(lock_a, lockwatch.TrackedLock)
+    assert isinstance(lock_b, lockwatch.TrackedRLock)
+    with lock_a:
+        with lock_b:  # one consistent-order edge; never a cycle
+            pass
+    edges = global_watch._edges  # keyed by creation site
+    assert any(
+        "test_lockwatch.py" in a and "test_lockwatch.py" in b
+        for (a, b) in edges
+    )
+
+
+def test_stdlib_primitives_survive_the_patch(global_watch):
+    """Event/Condition/Queue are built on the patched factories; the
+    proxies must keep the Condition protocol (full release across
+    wait) consistent or the held stacks go stale."""
+    event = threading.Event()
+    event.set()
+    assert event.wait(0.5)
+
+    q = queue.Queue()
+    q.put(42)
+    assert q.get(timeout=1) == 42
+
+    cond = threading.Condition()
+    woke = []
+
+    def waiter():
+        with cond:
+            woke.append(cond.wait(timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=10)
+    assert not t.is_alive() and woke == [True]
+
+
+def test_locked_and_context_protocol(global_watch):
+    lock = threading.Lock()
+    assert not lock.locked()
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+
+
+def test_finalizer_executor_shutdown_never_waits_on_the_lock():
+    """Regression for the watchdog's second catch: a GC finalizer
+    calling ``executor.shutdown()`` BLOCKS on ``_shutdown_lock`` and
+    can complete an AB/BA deadlock with two ``submit()``s (one holding
+    its executor lock waiting for the global shutdown lock, the other
+    holding the global lock when GC fires). The finalizer path of
+    ``shutdown_plugin_executor`` must trylock: shut down when
+    uncontended, skip (leave the executor to the exit reaper) when
+    not — never wait."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tpusnap.io_types import finalizer_close_scope, shutdown_plugin_executor
+
+    # Uncontended: behaves like shutdown(wait=False) — flag set, queued
+    # work still completes, no thread join.
+    ex = ThreadPoolExecutor(1)
+    fut = ex.submit(lambda: 42)
+    with finalizer_close_scope():
+        shutdown_plugin_executor(ex)
+    assert ex._shutdown
+    assert fut.result(timeout=10) == 42
+
+    # Contended: returns immediately instead of blocking — the deadlock
+    # scenario has another thread holding the shutdown lock forever.
+    ex2 = ThreadPoolExecutor(1)
+    assert ex2._shutdown_lock.acquire(timeout=5)
+    try:
+        done = threading.Event()
+
+        def finalizer_path():
+            with finalizer_close_scope():
+                shutdown_plugin_executor(ex2)
+            done.set()
+
+        t = threading.Thread(target=finalizer_path)
+        t.start()
+        assert done.wait(timeout=10), (
+            "finalizer shutdown blocked on a contended _shutdown_lock"
+        )
+        t.join(timeout=10)
+        assert not ex2._shutdown  # skipped, not half-applied
+    finally:
+        ex2._shutdown_lock.release()
+    ex2.shutdown(wait=True)

@@ -7,6 +7,16 @@ automatic resharding across mesh/world-size changes.
 
 from .version import __version__  # noqa: F401
 
+# Opt-in runtime lock-order watchdog. Installed BEFORE the submodule
+# imports below so every lock the package creates at import time is
+# tracked; off (the default) this costs one env read.
+from . import knobs as _knobs
+
+if _knobs.is_lockcheck_enabled():
+    from .devtools import lockwatch as _lockwatch
+
+    _lockwatch.install()
+
 # Populated as layers land; the full export set mirrors the reference's
 # torchsnapshot/__init__.py:35-41.
 __all__ = ["__version__"]

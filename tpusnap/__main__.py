@@ -70,6 +70,18 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
                         warn-severity finding fires (exit 3 = no
                         telemetry recorded, matching ``trace``)
 
+  lint                  AST invariant checker over the package source
+                        (``tpusnap/devtools/lint.py``): knob access only
+                        through knobs.py, monotonic-only clocks,
+                        canonical sidecar constants, no silent swallows
+                        in crash-safety modules, no blocking calls in
+                        scheduler coroutines, no finalizer-reachable
+                        joins, knob/doc drift — with per-line waivers
+                        (``# tpusnap: waive=<RULE> reason``);
+                        ``--check`` exits 2 on any unwaived finding
+                        (``--root`` lints another tree, ``--select``
+                        runs a rule subset, ``--json`` for machines)
+
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
 (or provably-different diff; history --check: regression; analyze
 --check: warn-severity finding), 3 undecidable/unverifiable (or no
@@ -685,12 +697,14 @@ def cmd_watch(args) -> int:
         render_watch_table,
     )
 
+    from .io_types import PROGRESS_DIR
+
     root = local_root_of(args.path)
     if root is None:
         print(
             f"error: {args.path!r} is not a local filesystem path — "
-            "`watch` tails the local heartbeat files under "
-            ".tpusnap/progress/",
+            f"`watch` tails the local heartbeat files under "
+            f"{PROGRESS_DIR}/",
             file=sys.stderr,
         )
         return 1
@@ -872,6 +886,12 @@ def cmd_cat(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    from .devtools import lint as _lint
+
+    return _lint.main(args)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tpusnap", description=__doc__.split("\n")[0]
@@ -936,10 +956,12 @@ def main(argv=None) -> int:
     )
     p.set_defaults(fn=cmd_trace)
 
+    from .io_types import PROGRESS_DIR
+
     p = sub.add_parser(
         "watch",
         help="live per-rank progress table of an in-flight take "
-        "(tails .tpusnap/progress/ heartbeat records)",
+        f"(tails {PROGRESS_DIR}/ heartbeat records)",
     )
     p.add_argument("path")
     p.add_argument(
@@ -1091,6 +1113,31 @@ def main(argv=None) -> int:
     p.add_argument("--keep", type=int, required=True, metavar="N")
     p.add_argument("--dry-run", action="store_true")
     p.set_defaults(fn=cmd_retain)
+
+    p = sub.add_parser(
+        "lint",
+        help="AST invariant checker over the package source (knob "
+        "access, monotonic clocks, sidecar literals, silent swallows, "
+        "async blocking calls, finalizer joins, knob/doc drift); "
+        "--check exits 2 on findings",
+    )
+    p.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="package directory to lint (default: the installed "
+        "tpusnap package)",
+    )
+    p.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="gate mode: exit 2 on any unwaived finding, 0 on clean",
+    )
+    p.set_defaults(fn=cmd_lint)
 
     try:
         args = parser.parse_args(argv)

@@ -20,14 +20,13 @@ safe to call with ANY buffer: non-pool buffers are ignored.
 
 from __future__ import annotations
 
-import os
 import threading
 import weakref
 from typing import Dict, List, Tuple
 
 import numpy as np
 
-from . import telemetry
+from . import knobs, telemetry
 
 _lock = threading.Lock()
 _free: List[Tuple[int, np.ndarray]] = []  # [(nbytes, buffer)]
@@ -39,13 +38,7 @@ _outstanding: Dict[int, "weakref.ref"] = {}
 
 
 def _cap_bytes() -> int:
-    val = os.environ.get("TPUSNAP_STAGING_POOL_BYTES")
-    if val is None:
-        return 4 << 30
-    try:
-        return max(0, int(val))
-    except ValueError:
-        return 4 << 30
+    return knobs.get_staging_pool_bytes()
 
 
 def acquire(nbytes: int) -> np.ndarray:

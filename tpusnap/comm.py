@@ -209,7 +209,12 @@ class JaxCoordinationComm(Communicator):
             try:
                 self._client.key_value_delete(prefix)
             except Exception:
-                pass
+                # Best-effort gc of proved-consumed KV prefixes; a leaked
+                # key costs service memory, not correctness.
+                logger.debug(
+                    "coordination-KV gc delete failed for %r", prefix,
+                    exc_info=True,
+                )
 
     def set_wait_watcher(self, watcher) -> None:
         self._wait_watcher = watcher

@@ -1,0 +1,10 @@
+"""Project-native developer tooling: the AST invariant checker
+(:mod:`tpusnap.devtools.lint`, ``python -m tpusnap lint``) and the
+runtime lock-order watchdog (:mod:`tpusnap.devtools.lockwatch`,
+``TPUSNAP_LOCKCHECK=1``).
+
+Kept import-light on purpose: this package is imported from
+``tpusnap/__init__`` (lockcheck auto-install) before the heavy
+JAX-facing modules, and the lint engine must be runnable against a
+source TREE without importing it.
+"""

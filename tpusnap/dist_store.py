@@ -134,7 +134,10 @@ class CoordinationKVStore(KVStore):
         try:
             self._client.key_value_delete(self._k(prefix))
         except Exception:
-            pass
+            # Best-effort cleanup; a leaked key costs service memory only.
+            logger.debug(
+                "KV delete_prefix(%r) failed", prefix, exc_info=True
+            )
 
 
 class FileKVStore(KVStore):
@@ -278,7 +281,14 @@ class TakeAbortMonitor:
         try:
             self._store.set(f"{self._prefix()}commit_started", b"1")
         except Exception:
-            pass
+            # Swallowed deliberately, but not silent: if the flag never
+            # lands, aborting peers fall back to commit_may_have_started's
+            # conservative True and keep their staged blobs.
+            logger.debug(
+                "commit_started flag publish failed for take %s",
+                self.take_id,
+                exc_info=True,
+            )
 
     def commit_may_have_started(self) -> bool:
         try:
@@ -331,7 +341,11 @@ class TakeAbortMonitor:
         try:
             self._store.delete_prefix(self._prefix())
         except Exception:
-            pass
+            logger.debug(
+                "abort-prefix cleanup failed for take %s",
+                self.take_id,
+                exc_info=True,
+            )
 
 
 class LinearBarrier:

@@ -303,7 +303,9 @@ class FaultInjectionStoragePlugin(StoragePlugin):
                 try:
                     await self.inner.write(WriteIO(path=write_io.path, buf=torn))
                 except Exception:
-                    pass  # the torn write itself may fail; either way we raise
+                    # tpusnap: waive=TPS004 the torn write itself may
+                    # fail; the InjectedFaultError below raises either way
+                    pass
                 raise InjectedFaultError(
                     f"injected torn write: {keep}/{len(write_io.buf)} bytes "
                     f"of {write_io.path!r} persisted"
@@ -337,6 +339,8 @@ class FaultInjectionStoragePlugin(StoragePlugin):
 
                     read_io.buf = _io.BytesIO(data[: self._torn_len(len(data))])
                 except Exception:
+                    # tpusnap: waive=TPS004 the trial read may fail too;
+                    # the InjectedFaultError below raises either way
                     pass
                 raise InjectedFaultError(
                     f"injected short read: {read_io.path!r}"

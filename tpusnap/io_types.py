@@ -37,6 +37,17 @@ BufferType = Union[bytes, bytearray, memoryview]
 # name specific subdirectories, deliberately narrower than the root.)
 SIDECAR_PREFIX = ".tpusnap/"
 
+# Canonical sidecar paths under the namespace root. Every layer that
+# writes or classifies sidecar traffic imports these — hardcoding the
+# string anywhere else is a lint violation (TPS003): a namespace that
+# exists in five private copies is five chances for fsck's
+# classification and the writers to drift apart.
+JOURNAL_PATH = SIDECAR_PREFIX + "journal"  # rank 0's take marker
+JOURNAL_RECORDS_DIR = SIDECAR_PREFIX + "journal.d"  # per-rank evidence
+PROGRESS_DIR = SIDECAR_PREFIX + "progress"  # heartbeat records
+TELEMETRY_DIR = SIDECAR_PREFIX + "telemetry"  # per-rank Chrome traces
+PROBE_DIR = SIDECAR_PREFIX + "probe"  # roofline probe streams
+
 T = TypeVar("T")
 
 
@@ -344,9 +355,39 @@ def shutdown_plugin_executor(executor) -> None:
     """The one place the join-on-close policy lives: explicit closes
     JOIN (abort-path quiescence — a straggler write thread surviving
     close could recreate a just-deleted blob of an aborted take);
-    GC-finalizer closes must NOT (see the deadlock note above).
+    GC-finalizer closes must NOT (see the deadlock note above) — and
+    must not even WAIT on the executor's shutdown lock:
+    ``ThreadPoolExecutor.shutdown`` blocks on ``_shutdown_lock``, while
+    ``submit`` holds its own ``_shutdown_lock`` and then the module's
+    ``_global_shutdown_lock``. GC can fire this finalizer on a thread
+    that is inside executor B's ``submit`` (holding the global lock)
+    while another thread is inside executor A's ``submit`` (holding
+    A's lock, waiting for the global one) — a blocking shutdown of A
+    here completes the AB/BA deadlock. The runtime lock-order watchdog
+    (tpusnap.devtools.lockwatch) caught exactly this interleaving in a
+    tier-1 run. So the finalizer path replicates
+    ``shutdown(wait=False)``'s body under a TRYLOCK and simply leaves
+    the executor to the interpreter's exit reaper when the lock is
+    contended (or the stdlib internals have moved).
     Executor-owning plugins call this from ``close()``."""
-    executor.shutdown(wait=close_may_join())
+    if close_may_join():
+        executor.shutdown(wait=True)
+        return
+    lock = getattr(executor, "_shutdown_lock", None)
+    try:
+        if lock is None or not lock.acquire(False):
+            return
+        try:
+            executor._shutdown = True
+            # Wake idle workers blocked in _work_queue.get so they exit
+            # instead of parking until interpreter shutdown.
+            executor._work_queue.put(None)
+        finally:
+            lock.release()
+    except Exception:
+        # Unknown executor shape: taking no lock beats taking a risk —
+        # the interpreter joins surviving workers at exit.
+        return
 
 
 def run_on_loop(event_loop: asyncio.AbstractEventLoop, coro):

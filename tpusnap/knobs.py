@@ -44,6 +44,8 @@ _ASYNC_COW_ENV_VAR = "TPUSNAP_ASYNC_COW"
 _PROBE_ENV_VAR = "TPUSNAP_PROBE"
 _PROBE_INTERVAL_ENV_VAR = "TPUSNAP_PROBE_INTERVAL_BYTES"
 _PROBE_BYTES_ENV_VAR = "TPUSNAP_PROBE_BYTES"
+_STAGING_POOL_ENV_VAR = "TPUSNAP_STAGING_POOL_BYTES"
+_LOCKCHECK_ENV_VAR = "TPUSNAP_LOCKCHECK"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -69,6 +71,7 @@ _DEFAULT_ASYNC_STAGE_WINDOW_BYTES = 2 * 1024 * 1024 * 1024
 # take's I/O, and a 20 GB take self-measures its ceiling ~10 times.
 _DEFAULT_PROBE_INTERVAL_BYTES = 2 * 1024 * 1024 * 1024
 _DEFAULT_PROBE_BYTES = 64 * 1024 * 1024
+_DEFAULT_STAGING_POOL_BYTES = 4 * 1024 * 1024 * 1024
 
 
 def _get_float_env(name: str, default: float) -> float:
@@ -388,6 +391,28 @@ def get_probe_bytes() -> int:
     return max(
         1024 * 1024, _get_int_env(_PROBE_BYTES_ENV_VAR, _DEFAULT_PROBE_BYTES)
     )
+
+
+def get_staging_pool_bytes() -> int:
+    """Cap on the reusable aligned staging-buffer pool
+    (:mod:`tpusnap._staging_pool`): released async-clone buffers up to
+    this many bytes are parked and handed back warm (no first-touch
+    page faults) to later takes and later pipelined-staging windows.
+    ``0`` disables the pool entirely (every clone allocates fresh)."""
+    return max(0, _get_int_env(_STAGING_POOL_ENV_VAR, _DEFAULT_STAGING_POOL_BYTES))
+
+
+def is_lockcheck_enabled() -> bool:
+    """Runtime lock-order watchdog (:mod:`tpusnap.devtools.lockwatch`),
+    OPT-IN via ``TPUSNAP_LOCKCHECK=1``: every ``threading.Lock``/
+    ``RLock`` created after import is wrapped to record the per-thread
+    held-lock stack and a global lock-order graph; AB/BA cycles
+    (potential deadlocks) and locks held across storage I/O are
+    reported at process exit and via the lockwatch API. Off by default:
+    the instrumentation adds a pure-Python hop to every lock
+    acquisition. The tier-1 test run enables it so the whole suite
+    doubles as a deadlock detector."""
+    return os.environ.get(_LOCKCHECK_ENV_VAR, "0") == "1"
 
 
 def get_memory_budget_override_bytes() -> Optional[int]:

@@ -46,7 +46,17 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import _native, telemetry
-from .io_types import SIDECAR_PREFIX, ReadIO, StoragePlugin, WriteIO
+from .io_types import (
+    JOURNAL_PATH,
+    JOURNAL_RECORDS_DIR,
+    PROBE_DIR,
+    PROGRESS_DIR,
+    SIDECAR_PREFIX,
+    TELEMETRY_DIR,
+    ReadIO,
+    StoragePlugin,
+    WriteIO,
+)
 from .manifest import MetadataError, SnapshotMetadata, decode_metadata
 
 logger = logging.getLogger(__name__)
@@ -59,17 +69,18 @@ __all__ = [
     "gc_snapshot",
 ]
 
-JOURNAL_FNAME = ".tpusnap/journal"
-JOURNAL_RECORDS_DIR = ".tpusnap/journal.d"
+# Canonical sidecar paths live in io_types; the historical local name
+# is kept for external callers (tests import JOURNAL_FNAME from here).
+JOURNAL_FNAME = JOURNAL_PATH
 _SIDECAR_PREFIX = SIDECAR_PREFIX  # canonical definition: io_types
 # Heartbeat records (tpusnap.progress): observability-only — ignored by
 # fsck's empty/foreign decision, legit in committed snapshots.
-_PROGRESS_SIDECAR_PREFIX = ".tpusnap/progress/"
+_PROGRESS_SIDECAR_PREFIX = PROGRESS_DIR + "/"
 # Roofline probe streams (scheduler._ProbeRunner, TPUSNAP_PROBE=1):
 # transient; ignored by the empty/foreign decision (a stranded stream
 # must not make an aborted dir unreusable) but NOT legit post-commit —
 # in a committed snapshot a leftover is an orphan gc reclaims.
-_PROBE_SIDECAR_PREFIX = ".tpusnap/probe/"
+_PROBE_SIDECAR_PREFIX = PROBE_DIR + "/"
 
 
 def journal_rank_path(rank: int) -> str:
@@ -173,11 +184,18 @@ def clear_journal(
         try:
             storage.sync_delete(journal_rank_path(r), event_loop)
         except Exception:
-            pass
+            # A surviving record file under a cleared marker is inert
+            # (fsck flags it as an orphan); the take is committed.
+            logger.debug(
+                "journal record delete failed (rank %d)", r, exc_info=True
+            )
     try:
         storage.sync_delete(JOURNAL_FNAME, event_loop)
     except Exception:
-        pass
+        # Marker outliving the commit keeps the dir classifiable
+        # (valid metadata + journal = committed); not worth failing a
+        # finished take over, but worth a trace.
+        logger.debug("journal marker delete failed", exc_info=True)
 
 
 def load_salvage_records(
@@ -418,7 +436,12 @@ class JournalingStoragePlugin(StoragePlugin):
 
     async def close(self) -> None:
         if self._executor is not None:
-            self._executor.shutdown(wait=True)
+            # Finalizer-safe join policy (io_types): explicit closes
+            # join the hash worker (quiescence), GC-finalizer closes
+            # must not (the PR 6 Thread._set_tstate_lock self-deadlock).
+            from .io_types import shutdown_plugin_executor
+
+            shutdown_plugin_executor(self._executor)
             self._executor = None
         await self.inner.close()
 
@@ -513,7 +536,7 @@ def _is_legit_sidecar(path: str) -> bool:
     journal/telemetry/heartbeat atomic write — is reclaimable, so both
     count as orphans."""
     return (
-        path.startswith((".tpusnap/telemetry/", ".tpusnap/progress/"))
+        path.startswith((TELEMETRY_DIR + "/", _PROGRESS_SIDECAR_PREFIX))
         and ".tmp." not in path.rsplit("/", 1)[-1]
     )
 

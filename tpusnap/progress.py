@@ -61,7 +61,7 @@ from .knobs import (
 
 logger = logging.getLogger(__name__)
 
-PROGRESS_DIR = ".tpusnap/progress"
+from .io_types import PROGRESS_DIR  # canonical sidecar path (io_types)
 
 # Wall-clock seam: record timestamps only; every duration/throttle
 # computation here runs on the injectable monotonic ``clock`` — direct

@@ -49,7 +49,15 @@ from typing import Callable, List, Optional, Set
 import psutil
 
 from . import telemetry
-from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq, run_on_loop
+from .io_types import (
+    PROBE_DIR,
+    ReadIO,
+    ReadReq,
+    StoragePlugin,
+    WriteIO,
+    WriteReq,
+    run_on_loop,
+)
 from .knobs import get_memory_budget_override_bytes
 
 logger = logging.getLogger(__name__)
@@ -285,7 +293,7 @@ class _ProbeRunner:
         return self._buf
 
     def _path(self, i: int) -> str:
-        return f".tpusnap/probe/rank_{self.rank}_{i}.bin"
+        return f"{PROBE_DIR}/rank_{self.rank}_{i}.bin"
 
     async def run(self) -> None:
         """One probe segment. Caller guarantees no blob I/O in flight

@@ -126,7 +126,20 @@ class Snapshot:
 
     def close(self) -> None:
         """Release the cached storage plugin and event loop."""
-        with self._op_lock:
+        self._close(blocking=True)
+
+    def _close(self, blocking: bool) -> None:
+        # The finalizer path (__del__) must NOT block on _op_lock: GC
+        # can fire on a thread that holds arbitrary locks (e.g. the
+        # executor's shutdown locks inside submit), and blocking there
+        # while another snapshot's op holds ITS _op_lock and submits is
+        # one unlucky schedule from an AB/BA deadlock — the lockwatch
+        # watchdog flagged exactly this edge. A contended _op_lock from
+        # __del__ means the object is still in use; skipping the close
+        # leaks nothing (the next explicit close or GC pass retries).
+        if not self._op_lock.acquire(blocking):
+            return
+        try:
             # GC may run __del__ from inside another running event loop
             # (e.g. while a different snapshot's coroutines execute);
             # run_until_complete is illegal there, so skip the graceful
@@ -151,10 +164,37 @@ class Snapshot:
             if self._cached_loop is not None:
                 try:
                     if not self._cached_loop.is_running():
+                        if not blocking:
+                            # Finalizer path: loop.close() — here or in
+                            # asyncio's own __del__ if we cannot close —
+                            # shuts down the loop's DEFAULT executor
+                            # (run_in_executor(None, ...), the read-abort
+                            # drain uses it) with a BLOCKING
+                            # _shutdown_lock acquire, the exact GC-inside-
+                            # submit AB/BA window shutdown_plugin_executor
+                            # documents. Detach it and trylock-shutdown
+                            # instead (we are inside finalizer_close_scope,
+                            # so the helper takes the no-wait branch).
+                            self._detach_default_executor(self._cached_loop)
                         self._cached_loop.close()
                 except Exception:
                     pass
             self._cached_loop = None
+        finally:
+            self._op_lock.release()
+
+    @staticmethod
+    def _detach_default_executor(loop) -> None:
+        from .io_types import shutdown_plugin_executor
+
+        try:
+            executor = loop._default_executor
+            if executor is None:
+                return
+            loop._default_executor = None
+        except Exception:
+            return
+        shutdown_plugin_executor(executor)
 
     def __enter__(self) -> "Snapshot":
         return self
@@ -174,7 +214,7 @@ class Snapshot:
 
         try:
             with finalizer_close_scope():
-                self.close()
+                self._close(blocking=False)
         except Exception:
             pass
 

@@ -25,6 +25,7 @@ import time
 from importlib.metadata import entry_points
 from typing import Any, Dict, Optional
 
+from .devtools import lockwatch as _lockwatch
 from .io_types import SIDECAR_PREFIX, ReadIO, StoragePlugin, WriteIO
 
 _ENTRY_POINT_GROUP = "tpusnap.storage_plugins"
@@ -153,6 +154,16 @@ class InstrumentedStoragePlugin(StoragePlugin):
     # would drag p50 down and fire the p99/p50 gate on a healthy disk.
     _UNSAMPLED_PREFIX = SIDECAR_PREFIX
 
+    @staticmethod
+    def _note_blocking(op: str) -> None:
+        """Lock-order watchdog hook (TPUSNAP_LOCKCHECK=1): record any
+        tracked lock the calling thread holds across this storage op —
+        a lock held for a disk/network round-trip is a starvation
+        hazard worth a name in the report. Disabled (the default) this
+        is one call + one None check; lockwatch itself is import-light
+        (threading/atexit only)."""
+        _lockwatch.note_blocking(f"storage_{op}")
+
     def _observe(self, op: str, path: str, t0: float, nbytes: int) -> None:
         if path.startswith(self._UNSAMPLED_PREFIX):
             return
@@ -167,11 +178,13 @@ class InstrumentedStoragePlugin(StoragePlugin):
 
     async def write(self, write_io: WriteIO) -> None:
         t0 = time.monotonic()
+        self._note_blocking("write")
         await self.inner.write(write_io)
         self._observe("write", write_io.path, t0, len(write_io.buf))
 
     async def write_atomic(self, write_io: WriteIO, durable: bool = False) -> None:
         t0 = time.monotonic()
+        self._note_blocking("write")
         await self.inner.write_atomic(write_io, durable=durable)
         self._observe("write", write_io.path, t0, len(write_io.buf))
 
@@ -188,6 +201,7 @@ class InstrumentedStoragePlugin(StoragePlugin):
 
     async def read(self, read_io: ReadIO) -> None:
         t0 = time.monotonic()
+        self._note_blocking("read")
         await self.inner.read(read_io)
         self._observe("read", read_io.path, t0, self._read_nbytes(read_io))
 

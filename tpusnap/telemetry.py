@@ -64,7 +64,7 @@ from .knobs import is_telemetry_enabled
 
 logger = logging.getLogger(__name__)
 
-TELEMETRY_DIR = ".tpusnap/telemetry"
+from .io_types import TELEMETRY_DIR  # canonical sidecar path (io_types)
 
 # Wall-clock seam: timestamps only (started_at); ALL duration math in
 # this file is monotonic — direct wall-clock CALLS are lint-forbidden
