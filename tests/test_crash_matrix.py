@@ -144,6 +144,10 @@ state = {
     for i in range(12)
 }
 os.environ["TPUSNAP_DISABLE_BATCHING"] = "1"
+# Tight heartbeat cadence: the flight recorder's flush rides the pump,
+# so this bounds the black-box loss window the parent's timeline
+# assertions depend on.
+os.environ["TPUSNAP_HEARTBEAT_INTERVAL_S"] = "0.05"
 
 if window == "residual_io":
     pending = Snapshot.async_take(path, {"app": StateDict(**state)})
@@ -153,6 +157,51 @@ else:
     Snapshot.take(path, {"app": StateDict(**state)})
 print("DONE", flush=True)
 """
+
+
+def _timeline_json(path: str):
+    """In-process ``tpusnap timeline --json`` (spawning a fresh
+    interpreter per matrix window would pay a jax import each)."""
+    import contextlib
+    import io
+    import json
+
+    from tpusnap.__main__ import main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(
+        io.StringIO()
+    ):
+        rc = main(["timeline", path, "--json"])
+    out = buf.getvalue().strip()
+    return rc, (json.loads(out) if out else None)
+
+
+def _assert_timeline_postmortem(path, window, seed, kill_jitter_s) -> None:
+    """Every SIGKILL window: the surviving flight sidecar must let
+    ``tpusnap timeline`` name what the killed rank was doing.
+
+    The journal window kills BEFORE the heartbeat pump (and with it the
+    flight flusher) starts, so it legitimately has no flight data —
+    that exercises the exit-3 leg of the contract instead."""
+    rc, doc = _timeline_json(path)
+    if window == "journal":
+        assert rc in (3, 4), (window, seed, rc)
+        return
+    assert rc == 4, (window, seed, rc, doc)
+    verdict = (doc or {}).get("verdict") or {}
+    r0 = (verdict.get("ranks") or {}).get("0")
+    assert r0 is not None, (window, seed, doc)
+    # The last completed phase is always on record (the pump's first
+    # flush lands before any kill window opens).
+    assert r0.get("phase") is not None, (window, seed, r0)
+    assert r0.get("last_event") is not None, (window, seed, r0)
+    if window == "staging" and kill_jitter_s >= 0.15:
+        # The kill landed ≥3 flush intervals into the staging sleep, so
+        # the last flushed context must name the wedged op and the
+        # planned byte denominator.
+        assert r0.get("inflight_op") is not None, (window, seed, r0)
+        assert (r0.get("bytes_planned") or 0) > 0, (window, seed, r0)
 
 
 def _run_window(tmp_path, window: str, seed: int, extra_env=None) -> None:
@@ -197,7 +246,8 @@ def _run_window(tmp_path, window: str, seed: int, extra_env=None) -> None:
             )
         # Seeded jitter: kills land at varied instants inside (and
         # occasionally after) the window.
-        time.sleep(random.Random(seed).uniform(0.0, 1.5))
+        kill_jitter_s = random.Random(seed).uniform(0.0, 1.5)
+        time.sleep(kill_jitter_s)
         os.killpg(proc.pid, signal.SIGKILL)
         proc.wait(timeout=60)
     finally:
@@ -235,6 +285,10 @@ def _run_window(tmp_path, window: str, seed: int, extra_env=None) -> None:
         report = fsck_snapshot(path)
         if os.path.exists(os.path.join(path, ".tpusnap/journal")):
             assert report.state == "torn", (window, seed, report.summary())
+            # The black box survived the SIGKILL: `tpusnap timeline`
+            # reconstructs what the killed rank was doing from the
+            # flushed flight sidecar.
+            _assert_timeline_postmortem(path, window, seed, kill_jitter_s)
         else:
             assert report.state in ("empty", "foreign"), (
                 window,

@@ -330,6 +330,28 @@ def test_last_take_summary_exposed(tmp_path):
     assert s is not None and s["counters"]["storage.writes"] >= 1
 
 
+def test_clean_take_records_no_fatal_payload_retries(tmp_path):
+    """Regression (BENCH_r06 stray ``retry.fatal.read: 1``): the journal
+    probe at take start 404s on every fresh path, and other
+    sidecar-namespace misses are expected probes, not payload failures —
+    none of them may surface as ``retry.fatal.*`` payload counters in
+    the take's stage_breakdown. The sidecar family keeps its own label
+    (``retry.fatal.sidecar.*``) so real sidecar storage failures stay
+    observable."""
+    Snapshot.take(str(tmp_path / "snap"), {"m": PytreeState(_state())})
+    counters = telemetry.LAST_TAKE_SUMMARY["counters"]
+    fatal_payload = {
+        k: v
+        for k, v in counters.items()
+        if k.startswith("retry.fatal.")
+        and not k.startswith("retry.fatal.sidecar.")
+    }
+    assert not fatal_payload, fatal_payload
+    # The probe that used to pollute the payload counter is the journal
+    # read; on a fresh path it lands under the sidecar family instead.
+    assert counters.get("retry.fatal.sidecar.read", 0) >= 1, counters
+
+
 # ------------------------------------------------------------ trace CLI
 
 

@@ -72,8 +72,20 @@ try:  # pragma: no cover - import surface grows as modules land
         load_history,
         record_event,
     )
+    from .flight import (  # noqa: F401
+        FlightRecorder,
+        estimate_skew,
+        load_flight_logs,
+        merge_timeline,
+        postmortem_verdict,
+    )
 
     __all__ += [
+        "FlightRecorder",
+        "estimate_skew",
+        "load_flight_logs",
+        "merge_timeline",
+        "postmortem_verdict",
         "IOStats",
         "LogHistogram",
         "Attribution",

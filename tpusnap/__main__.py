@@ -70,6 +70,23 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
                         warn-severity finding fires (exit 3 = no
                         telemetry recorded, matching ``trace``)
 
+  timeline    PATH      forensic cross-rank timeline from the flight-
+                        recorder sidecars (.tpusnap/flight/rank_<k>.jsonl,
+                        falling back to the local TPUSNAP_TELEMETRY_DIR
+                        copy): all ranks' event logs merged in causal
+                        order using barrier-anchored clock-skew
+                        alignment (per-rank offset ± bound reported);
+                        for any UNCOMMITTED path a post-mortem verdict
+                        names, per rank, the in-flight op, last
+                        completed phase, bytes staged/written vs
+                        planned, journal.d completion evidence, stall
+                        episodes and the missing-rank set
+                        (``--rank K`` one rank, ``--last N`` newest N
+                        events, ``--around T [--window S]`` events near
+                        T seconds into the timeline, ``--json``; exit 0
+                        = committed, 4 = uncommitted post-mortem, 3 =
+                        no flight data recorded)
+
   lint                  AST invariant checker over the package source
                         (``tpusnap/devtools/lint.py``): knob access only
                         through knobs.py, monotonic-only clocks,
@@ -85,9 +102,10 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
 (or provably-different diff; history --check: regression; analyze
 --check: warn-severity finding), 3 undecidable/unverifiable (or no
-telemetry recorded — trace and analyze; fsck: empty/foreign; history:
-no/insufficient events), 4 torn take (fsck — salvageable by retaking
-the path).
+telemetry recorded — trace and analyze; no flight data — timeline;
+fsck: empty/foreign; history: no/insufficient events), 4 torn take
+(fsck — salvageable by retaking the path; timeline: uncommitted path,
+post-mortem verdict printed).
 """
 
 from __future__ import annotations
@@ -655,7 +673,46 @@ def cmd_analyze(args) -> int:
         )
         kind = "restore"
     else:
-        _world, rollup, rank_docs = _load_take_traces(args.path)
+        try:
+            _world, rollup, rank_docs = _load_take_traces(args.path)
+        except Exception:
+            # Not a committed snapshot. A torn/killed/aborted path has
+            # no telemetry rollup to analyze — but it usually has a
+            # black box: fold the flight recorder's post-mortem verdict
+            # in instead of a bare load error.
+            report, logs, verdict = _load_flight_view(args.path)
+            if report.state == "committed":
+                raise  # a committed snapshot failing to load is a real error
+            if not logs:
+                if report.state in ("empty", "foreign"):
+                    # Nothing tpusnap-shaped here at all — a typo'd
+                    # path must surface the original load error (exit
+                    # 1), not a misleading "flight recording was off".
+                    raise
+                print(_NO_FLIGHT_MSG, file=sys.stderr)
+                return 3
+            if args.json:
+                print(
+                    _json.dumps(
+                        {
+                            "path": args.path,
+                            "state": report.state,
+                            "verdict": verdict,
+                        }
+                    )
+                )
+            else:
+                print(f"path:   {args.path}")
+                print(
+                    f"state:  {report.state} — not a committed snapshot; "
+                    "per-phase analysis needs a committed trace"
+                )
+                _render_verdict(verdict)
+                print(
+                    "\n(`python -m tpusnap timeline` shows the merged "
+                    "cross-rank event timeline)"
+                )
+            return 4
         if rollup is None and rank_docs:
             rollup = rollup_summaries(
                 [d.get("summary") or {} for d in rank_docs.values()]
@@ -684,6 +741,226 @@ def cmd_analyze(args) -> int:
     if args.check and report.get("check_failed"):
         return 2
     return 0
+
+
+_NO_FLIGHT_MSG = (
+    "no flight data recorded (TPUSNAP_FLIGHT=0, a pre-flight-recorder "
+    "snapshot, or the take died before its first flush)"
+)
+
+
+def _fmt_rel_bytes(n) -> str:
+    return _fmt_bytes(int(n)) if n else "0B"
+
+
+def _flight_verdict(path: str, fsck_report, logs, resources=None) -> dict:
+    """The post-mortem verdict for an uncommitted path (shared by
+    ``timeline`` and ``analyze``)."""
+    from .flight import _journal_evidence, postmortem_verdict
+
+    world = None
+    if fsck_report.journal is not None:
+        world = fsck_report.journal.world_size
+    elif fsck_report.metadata is not None:
+        world = fsck_report.metadata.world_size
+    evidence = _journal_evidence(fsck_report.files, path, resources=resources)
+    return postmortem_verdict(
+        path, fsck_report.state, logs, world_size=world,
+        journal_evidence=evidence,
+    )
+
+
+def _load_flight_view(path: str):
+    """(fsck_report, logs, verdict_or_None) for ``path``, read through
+    ONE storage plugin + event loop — the shared orchestration behind
+    ``timeline`` and ``analyze``'s uncommitted-path fold.
+
+    Stale-sidecar filter: a torn take's journal names the current
+    take_id; flight logs left by a PREVIOUS take to the same path (a
+    retake overwrites only the ranks it runs) would otherwise merge
+    into the verdict as live ranks — and their recurring barrier anchor
+    strings would poison the skew estimate across takes. Logs whose
+    header names a different take are dropped (headerless logs are
+    kept, best-effort); the filtered-out ranks then correctly show as
+    missing."""
+    import asyncio
+
+    from .flight import load_flight_logs
+    from .lifecycle import fsck_snapshot
+    from .storage_plugin import url_to_storage_plugin_in_event_loop
+
+    event_loop = asyncio.new_event_loop()
+    try:
+        storage = url_to_storage_plugin_in_event_loop(path, event_loop)
+        try:
+            resources = (event_loop, storage)
+            report = fsck_snapshot(path, resources=resources)
+            logs = load_flight_logs(
+                path, files=report.files, resources=resources
+            )
+            expected = (
+                report.journal.take_id if report.journal is not None else None
+            )
+            if expected is None and logs:
+                # Committed path: rank 0 participates in every take and
+                # its sidecar is rewritten by the committing take, so
+                # its header names the current take — leftover sidecars
+                # from a wider previous take must not merge in (their
+                # recurring barrier anchor strings would also poison
+                # the skew estimate across takes).
+                ref = logs.get(min(logs)) or {}
+                expected = (ref.get("meta") or {}).get("take_id")
+            if expected:
+                logs = {
+                    rank: doc
+                    for rank, doc in logs.items()
+                    if (doc.get("meta") or {}).get("take_id")
+                    in (None, expected)
+                }
+            verdict = (
+                _flight_verdict(path, report, logs, resources=resources)
+                if report.state != "committed" and logs
+                else None
+            )
+        finally:
+            storage.sync_close(event_loop)
+    finally:
+        event_loop.close()
+    return report, logs, verdict
+
+
+def _render_verdict(verdict: dict) -> None:
+    print(f"\nPOST-MORTEM (state: {verdict['state']}):")
+    for rank, r in sorted(verdict["ranks"].items()):
+        ops = r.get("inflight_ops") or []
+        op = r.get("inflight_op")
+        op_desc = op or "-"
+        if op and len(ops) > 1:
+            op_desc += f" (+{len(ops) - 1} more in flight)"
+        print(
+            f"  rank {rank}: state={r.get('state', '?')}  "
+            f"phase={r.get('phase') or '-'}  in-flight op={op_desc}"
+        )
+        planned = r.get("bytes_planned")
+        if planned:
+            pct = r.get("percent")
+            print(
+                f"          bytes: {_fmt_rel_bytes(r.get('bytes_written'))} "
+                f"written / {_fmt_rel_bytes(planned)} planned"
+                + (f" ({pct:.1f}%)" if pct is not None else "")
+                + f", {_fmt_rel_bytes(r.get('bytes_staged'))} staged"
+            )
+        j = r.get("journal")
+        if j:
+            print(
+                f"          journal evidence: {j['blobs_completed']} "
+                f"blob(s) fully written "
+                f"({_fmt_rel_bytes(j['bytes_completed'])} intact on disk)"
+            )
+        last = r.get("last_event")
+        if last:
+            age = last.get("flush_age_s")
+            print(
+                f"          last event: {last.get('k')} "
+                f"{last.get('op') or ''}".rstrip()
+                + (
+                    f", {age:.2f}s before the final flush (up to one "
+                    "flush interval of newer events died with the "
+                    "process)"
+                    if age is not None
+                    else ""
+                )
+            )
+        if r.get("dropped"):
+            print(
+                f"          ring evicted {r['dropped']} older event(s) "
+                "(raise TPUSNAP_FLIGHT_RING for longer black boxes)"
+            )
+    for rank in verdict.get("missing_ranks", []):
+        print(
+            f"  rank {rank}: NO FLIGHT DATA — killed before its first "
+            "flush, a non-local destination, or the host died with its "
+            "telemetry dir"
+        )
+    stalls = verdict.get("stall_episodes", 0)
+    print(f"  stall episodes across ranks: {stalls}")
+
+
+def cmd_timeline(args) -> int:
+    from .flight import estimate_skew, merge_timeline
+
+    report, logs, verdict = _load_flight_view(args.path)
+    if not logs:
+        print(_NO_FLIGHT_MSG, file=sys.stderr)
+        return 3
+    skew = estimate_skew(logs)
+    events = merge_timeline(logs, skew)
+    t0 = events[0]["wall"] if events else 0.0
+    shown = events
+    if args.rank is not None:
+        shown = [e for e in shown if e["rank"] == args.rank]
+    if args.around is not None:
+        lo, hi = args.around - args.window, args.around + args.window
+        shown = [e for e in shown if lo <= e["wall"] - t0 <= hi]
+    if args.last:
+        shown = shown[-args.last :]
+    if args.json:
+        import json as _json
+
+        print(
+            _json.dumps(
+                {
+                    "path": args.path,
+                    "state": report.state,
+                    "ranks": sorted(logs),
+                    "skew": {str(r): s for r, s in sorted(skew.items())},
+                    "events": shown,
+                    "verdict": verdict,
+                }
+            )
+        )
+    else:
+        print(f"path:   {args.path}")
+        print(f"state:  {report.state} (fsck)")
+        print(f"ranks:  {sorted(logs)} with flight data")
+        multi = len(logs) > 1
+        if multi:
+            print("clock alignment (barrier-anchored, relative to the "
+                  "lowest rank):")
+            for r, s in sorted(skew.items()):
+                if s.get("anchors") is None:
+                    continue  # the reference rank
+                if s["anchors"]:
+                    print(
+                        f"  rank {r}: {s['offset_s'] * 1e3:+.2f}ms "
+                        f"±{s['bound_s'] * 1e3:.2f}ms "
+                        f"({s['anchors']} shared barrier anchor(s))"
+                    )
+                else:
+                    print(
+                        f"  rank {r}: no shared barrier anchors — "
+                        "wall-clock ordering only"
+                    )
+        print(
+            f"\ntimeline ({len(shown)} of {len(events)} event(s); "
+            "+seconds since the first):"
+        )
+        for e in shown:
+            extra = " ".join(
+                f"{k}={v}"
+                for k, v in e.items()
+                if k not in ("t", "k", "op", "rank", "wall") and v is not None
+            )
+            print(
+                f"  {e['wall'] - t0:+10.3f}s  r{e['rank']}  "
+                f"{e['k']:<14} {e.get('op') or '-'}"
+                + (f"  [{extra}]" if extra else "")
+            )
+        if verdict is not None:
+            _render_verdict(verdict)
+    if report.state == "committed":
+        return 0
+    return 4
 
 
 def cmd_watch(args) -> int:
@@ -997,8 +1274,9 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--kind", default="take",
-        choices=["take", "restore", "bench", "all"],
-        help="event kind to show/check (default take)",
+        choices=["take", "restore", "bench", "orbax", "all"],
+        help="event kind to show/check (default take; orbax = the "
+        "orbax_compare benchmark's median/speedup events)",
     )
     p.add_argument(
         "-n", "--limit", type=int, default=20, metavar="N",
@@ -1077,6 +1355,35 @@ def main(argv=None) -> int:
         "(default 2.0)",
     )
     p.set_defaults(fn=cmd_analyze)
+
+    p = sub.add_parser(
+        "timeline",
+        help="forensic cross-rank event timeline from the flight-"
+        "recorder sidecars; post-mortem verdict for uncommitted paths "
+        "(exit 0 committed / 4 uncommitted / 3 no flight data)",
+    )
+    p.add_argument("path")
+    p.add_argument(
+        "--rank", type=int, default=None, metavar="K",
+        help="show only rank K's events (skew/verdict still use all)",
+    )
+    p.add_argument(
+        "--last", type=int, default=0, metavar="N",
+        help="show only the newest N merged events (default: all)",
+    )
+    p.add_argument(
+        "--around", type=float, default=None, metavar="T",
+        help="show events within --window seconds of T seconds into "
+        "the timeline",
+    )
+    p.add_argument(
+        "--window", type=float, default=2.0, metavar="S",
+        help="half-width of the --around window (default 2.0s)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser(
         "fsck",

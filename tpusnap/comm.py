@@ -229,7 +229,15 @@ class JaxCoordinationComm(Communicator):
             self._barrier_impl()
 
     def _barrier_impl(self) -> None:
+        from . import flight
+
         seq = self._next_seq()
+        # Flight-recorder anchor: every rank logs the SAME anchor string
+        # for the same barrier, and the exit event fires at (nearly) the
+        # same instant on all ranks — the cross-rank clock-skew
+        # alignment `tpusnap timeline` runs on.
+        anchor = f"{self._namespace()}/b{seq}"
+        flight.record("barrier_enter", op=anchor)
         if self._wait_watcher is not None:
             # Abort-aware mode: the native wait_at_barrier blocks inside
             # the coordination client until its timeout and cannot
@@ -239,6 +247,7 @@ class JaxCoordinationComm(Communicator):
             # for the same seq because watcher installation is a fixed
             # point in the take's SPMD program.
             prefix = self._polling_barrier(seq)
+            flight.record("barrier_exit", op=anchor)
             # Flush BEFORE registering this barrier's own prefix: the
             # flush must never delete the depart key a slow rank is
             # still polling — this prefix is only provably consumed
@@ -251,9 +260,10 @@ class JaxCoordinationComm(Communicator):
         # explicit ones are sanitized), so this mapping is injective —
         # distinct namespaces can never satisfy each other's barriers.
         self._client.wait_at_barrier(
-            f"{self._namespace()}/b{seq}".replace("/", "."),
+            anchor.replace("/", "."),
             timeout_in_ms=self._timeout_ms,
         )
+        flight.record("barrier_exit", op=anchor)
         self._flush_gc()
 
     def _watched_wait_key(self, key: str, deadline: float):

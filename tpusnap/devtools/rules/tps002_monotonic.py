@@ -19,7 +19,7 @@ from ._common import member_alias_names, module_alias_names
 
 # The monotonic-only modules (PR 2's invariant). Paths relative to the
 # package root.
-SCOPED_MODULES = {"telemetry.py", "progress.py", "history.py"}
+SCOPED_MODULES = {"telemetry.py", "progress.py", "history.py", "flight.py"}
 
 
 class MonotonicClockRule(Rule):

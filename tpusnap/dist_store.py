@@ -443,8 +443,9 @@ class LinearBarrier:
         return missing
 
     def arrive(self) -> None:
-        from . import telemetry
+        from . import flight, telemetry
 
+        flight.record("barrier_enter", op=self.prefix)
         with telemetry.span("kv.barrier_arrive"):
             self.store.set(self._key("arrive", str(self.rank)), b"1")
             if self.rank == self.leader_rank:
@@ -452,13 +453,17 @@ class LinearBarrier:
                     self._checked_get(self._key("arrive", str(r)))
 
     def depart(self) -> None:
-        from . import telemetry
+        from . import flight, telemetry
 
         with telemetry.span("kv.barrier_depart"):
             if self.rank == self.leader_rank:
                 self.store.set(self._key("depart"), b"1")
             else:
                 self._checked_get(self._key("depart"))
+        # Release observed: the cross-rank skew anchor (every rank logs
+        # the same prefix within one poll interval of the leader's
+        # depart signal).
+        flight.record("barrier_exit", op=self.prefix)
 
     def report_error(self, exc: BaseException) -> None:
         try:

@@ -42,7 +42,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
-from . import telemetry
+from . import flight, telemetry
 from .io_types import ReadIO, StoragePlugin, WriteIO
 
 logger = logging.getLogger(__name__)
@@ -284,12 +284,16 @@ class FaultInjectionStoragePlugin(StoragePlugin):
             # no-forward-progress hang the watchdog must detect.
             telemetry.incr(f"faults.stalled.{kind}")
             telemetry.event("stall_injected", kind=kind, path=path, seconds=stall)
+            flight.record(
+                "fault_stall", op=kind, path=path, seconds=stall
+            )
             await asyncio.sleep(stall)
         if inject:
             # Always-on counter + instant trace event: a chaos take's
             # persisted trace shows exactly which ops drew faults.
             telemetry.incr(f"faults.injected.{kind}")
             telemetry.event("fault_injected", kind=kind, path=path)
+            flight.record("fault", op=kind, path=path)
         return inject
 
     # --- plugin interface -------------------------------------------------

@@ -47,6 +47,7 @@ JOURNAL_RECORDS_DIR = SIDECAR_PREFIX + "journal.d"  # per-rank evidence
 PROGRESS_DIR = SIDECAR_PREFIX + "progress"  # heartbeat records
 TELEMETRY_DIR = SIDECAR_PREFIX + "telemetry"  # per-rank Chrome traces
 PROBE_DIR = SIDECAR_PREFIX + "probe"  # roofline probe streams
+FLIGHT_DIR = SIDECAR_PREFIX + "flight"  # flight-recorder event logs
 
 T = TypeVar("T")
 

@@ -46,6 +46,9 @@ _PROBE_INTERVAL_ENV_VAR = "TPUSNAP_PROBE_INTERVAL_BYTES"
 _PROBE_BYTES_ENV_VAR = "TPUSNAP_PROBE_BYTES"
 _STAGING_POOL_ENV_VAR = "TPUSNAP_STAGING_POOL_BYTES"
 _LOCKCHECK_ENV_VAR = "TPUSNAP_LOCKCHECK"
+_FLIGHT_ENV_VAR = "TPUSNAP_FLIGHT"
+_FLIGHT_RING_ENV_VAR = "TPUSNAP_FLIGHT_RING"
+_FLIGHT_FLUSH_ENV_VAR = "TPUSNAP_FLIGHT_FLUSH_S"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -402,6 +405,40 @@ def get_staging_pool_bytes() -> int:
     return max(0, _get_int_env(_STAGING_POOL_ENV_VAR, _DEFAULT_STAGING_POOL_BYTES))
 
 
+def is_flight_enabled() -> bool:
+    """Black-box flight recorder (:mod:`tpusnap.flight`): on by default
+    — a bounded, lock-light ring buffer of structured events (spans,
+    phases, journal writes, retries, faults, barriers, stalls, probes)
+    flushed to crash-surviving sidecars at the heartbeat cadence, so a
+    SIGKILLed/wedged take leaves a forensic timeline
+    (``python -m tpusnap timeline``) instead of just a journal marker.
+    ``TPUSNAP_FLIGHT=0`` disables recording AND flushing entirely (the
+    disabled record path is one attribute check)."""
+    return os.environ.get(_FLIGHT_ENV_VAR, "1") != "0"
+
+
+def get_flight_ring_size() -> int:
+    """Flight-recorder ring capacity in EVENTS: the black box keeps the
+    newest this-many events (older ones are evicted and counted as
+    dropped in the flushed header). Bounded by design — the recorder's
+    memory and flush cost are O(ring), never O(take). Floor of 256 so a
+    misconfigured ring cannot reduce the black box to noise."""
+    return max(256, _get_int_env(_FLIGHT_RING_ENV_VAR, 4096))
+
+
+def get_flight_flush_interval_s() -> float:
+    """Cadence of the flight recorder's crash-surviving flush
+    (piggybacked on the heartbeat pump): the sidecar is rewritten
+    atomically at most once per interval, so after a SIGKILL — which no
+    handler can catch — AT MOST this many seconds of events are lost.
+    This knob IS the documented loss bound. Defaults to the heartbeat
+    interval (``TPUSNAP_HEARTBEAT_INTERVAL_S``)."""
+    val = _get_float_env(_FLIGHT_FLUSH_ENV_VAR, -1.0)
+    if val <= 0:
+        return get_heartbeat_interval_s()
+    return max(0.02, val)
+
+
 def is_lockcheck_enabled() -> bool:
     """Runtime lock-order watchdog (:mod:`tpusnap.devtools.lockwatch`),
     OPT-IN via ``TPUSNAP_LOCKCHECK=1``: every ``threading.Lock``/
@@ -582,6 +619,24 @@ def override_async_stage_window_bytes(nbytes: int) -> Generator[None, None, None
 @contextlib.contextmanager
 def override_async_cow(enabled: bool) -> Generator[None, None, None]:
     with _override_env(_ASYNC_COW_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_flight_enabled(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(_FLIGHT_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_flight_ring_size(n: int) -> Generator[None, None, None]:
+    with _override_env(_FLIGHT_RING_ENV_VAR, str(n)):
+        yield
+
+
+@contextlib.contextmanager
+def override_flight_flush_interval_s(seconds: float) -> Generator[None, None, None]:
+    with _override_env(_FLIGHT_FLUSH_ENV_VAR, str(seconds)):
         yield
 
 

@@ -45,8 +45,9 @@ import logging
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import _native, telemetry
+from . import _native, flight, telemetry
 from .io_types import (
+    FLIGHT_DIR,
     JOURNAL_PATH,
     JOURNAL_RECORDS_DIR,
     PROBE_DIR,
@@ -81,6 +82,12 @@ _PROGRESS_SIDECAR_PREFIX = PROGRESS_DIR + "/"
 # must not make an aborted dir unreusable) but NOT legit post-commit —
 # in a committed snapshot a leftover is an orphan gc reclaims.
 _PROBE_SIDECAR_PREFIX = PROBE_DIR + "/"
+# Flight-recorder event logs (tpusnap.flight): observability-only, the
+# same class as heartbeats — legit in committed snapshots (the black
+# box of the take that produced them) and exempt from the empty/foreign
+# decision (an aborted/killed take's forensic breadcrumb is the whole
+# point; it must not lock the path out of reuse).
+_FLIGHT_SIDECAR_PREFIX = FLIGHT_DIR + "/"
 
 
 def journal_rank_path(rank: int) -> str:
@@ -142,6 +149,9 @@ def write_journal(
         WriteIO(path=JOURNAL_FNAME, buf=journal.to_json().encode("utf-8")),
         event_loop,
     )
+    flight.record(
+        "journal", op="marker_written", take_id=journal.take_id[:8]
+    )
 
 
 def read_journal(
@@ -191,6 +201,7 @@ def clear_journal(
             )
     try:
         storage.sync_delete(JOURNAL_FNAME, event_loop)
+        flight.record("journal", op="marker_cleared")
     except Exception:
         # Marker outliving the commit keeps the dir classifiable
         # (valid metadata + journal = committed); not worth failing a
@@ -414,9 +425,16 @@ class JournalingStoragePlugin(StoragePlugin):
             telemetry.event(
                 "salvaged_blob", path=write_io.path, bytes=triple[0]
             )
+            flight.record(
+                "blob_salvaged", op=write_io.path, bytes=triple[0]
+            )
             await self._record(write_io.path, triple)
             return
         await self.inner.write(write_io)
+        # Completion evidence exists the moment the record lands; the
+        # flight event mirrors it so the post-mortem timeline shows
+        # which blobs PROVABLY finished before the lights went out.
+        flight.record("blob_complete", op=write_io.path, bytes=triple[0])
         await self._record(write_io.path, triple)
 
     async def write_atomic(self, write_io: WriteIO, durable: bool = False) -> None:
@@ -530,13 +548,20 @@ def _referenced_locations(metadata: SnapshotMetadata) -> set:
 
 def _is_legit_sidecar(path: str) -> bool:
     """Sidecars a committed snapshot legitimately carries: telemetry
-    traces and the final heartbeat records, nothing else. The journal
+    traces, the final heartbeat records and the flight-recorder event
+    logs, nothing else. The journal
     family is NOT legit post-commit (the commit clears it), and
     ``.tmp.<pid>`` debris anywhere — including a SIGKILLed
     journal/telemetry/heartbeat atomic write — is reclaimable, so both
     count as orphans."""
     return (
-        path.startswith((TELEMETRY_DIR + "/", _PROGRESS_SIDECAR_PREFIX))
+        path.startswith(
+            (
+                TELEMETRY_DIR + "/",
+                _PROGRESS_SIDECAR_PREFIX,
+                _FLIGHT_SIDECAR_PREFIX,
+            )
+        )
         and ".tmp." not in path.rsplit("/", 1)[-1]
     )
 
@@ -687,7 +712,13 @@ def _fsck_impl(
     meaningful = {
         p: sz
         for p, sz in files.items()
-        if not p.startswith((_PROGRESS_SIDECAR_PREFIX, _PROBE_SIDECAR_PREFIX))
+        if not p.startswith(
+            (
+                _PROGRESS_SIDECAR_PREFIX,
+                _PROBE_SIDECAR_PREFIX,
+                _FLIGHT_SIDECAR_PREFIX,
+            )
+        )
     }
     if meaningful:
         report.state = "foreign"

@@ -159,6 +159,11 @@ class ProgressMonitor:
             else get_stall_deadline_s()
         )
         self._attributions = list(attributions or [])
+        # Piggyback hooks run once per tick with the freshly built
+        # progress record (or None when the throttle skipped building
+        # one) — the flight recorder's flush rides here so its cadence
+        # shares this pump thread instead of owning another.
+        self._tick_hooks: List[Callable[[Optional[Dict[str, Any]]], None]] = []
         self._clock = clock
         self._wall = wall_clock
         self._state = "running"
@@ -192,6 +197,14 @@ class ProgressMonitor:
         """Register a callable the watchdog asks "which ranks are we
         waiting on?" when a stall fires (first non-empty answer wins)."""
         self._attributions.append(fn)
+
+    def add_tick_hook(
+        self, fn: Callable[[Optional[Dict[str, Any]]], None]
+    ) -> None:
+        """Register a per-tick piggyback (see ``_tick_hooks``).
+        Exceptions are swallowed per hook — the pump must survive any
+        subscriber."""
+        self._tick_hooks.append(fn)
 
     # --- the pump -------------------------------------------------------
 
@@ -227,7 +240,12 @@ class ProgressMonitor:
             self._stall_warned = False
         else:
             self._check_stall(now, snap)
-        self._maybe_publish(now, snap, force=force_publish)
+        record = self._maybe_publish(now, snap, force=force_publish)
+        for fn in self._tick_hooks:
+            try:
+                fn(record)
+            except Exception:
+                logger.debug("progress tick hook failed", exc_info=True)
 
     def _check_stall(self, now: float, snap: Dict[str, Any]) -> None:
         if self._stall_warned or self._state != "running":
@@ -268,6 +286,18 @@ class ProgressMonitor:
             "stalled_s": round(stalled_s, 1),
             "missing_ranks": missing,
         }
+        try:
+            from . import flight
+
+            flight.record(
+                "stall",
+                op=op,
+                stalled_s=round(stalled_s, 1),
+                phase=snap["phase"],
+                missing_ranks=missing,
+            )
+        except Exception:
+            logger.debug("flight stall record failed", exc_info=True)
         logger.warning(
             "tpusnap stall: rank %d made no forward progress for %.1fs "
             "inside op %r (last completed phase %r)%s",
@@ -287,7 +317,7 @@ class ProgressMonitor:
 
     def _maybe_publish(
         self, now: float, snap: Dict[str, Any], force: bool = False
-    ) -> None:
+    ) -> Optional[Dict[str, Any]]:
         due = (
             self._last_pub_t is None
             or now - self._last_pub_t >= self.interval_s
@@ -299,7 +329,7 @@ class ProgressMonitor:
             >= _KEEPALIVE_INTERVALS * self.interval_s
         )
         if not force and not (due and changed) and not keepalive:
-            return
+            return None
         record = self._record(now, snap)
         self._last_pub_t = now
         self._last_pub_sig = self._last_sig
@@ -315,6 +345,7 @@ class ProgressMonitor:
                 self.kv.set(self._kv_key(self.rank), payload.encode("utf-8"))
             except Exception:
                 logger.debug("heartbeat KV publish failed", exc_info=True)
+        return record
 
     def _kv_key(self, rank: int) -> str:
         return f"tpusnap_progress/{self.take_id}/{rank}"

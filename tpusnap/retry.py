@@ -38,8 +38,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
-from . import telemetry
-from .io_types import ReadIO, StoragePlugin, WriteIO
+from . import flight, telemetry
+from .io_types import SIDECAR_PREFIX, ReadIO, StoragePlugin, WriteIO
 
 logger = logging.getLogger(__name__)
 
@@ -212,12 +212,36 @@ class RetryingStoragePlugin(StoragePlugin):
         succeeds — the telemetry trace is how a chaos run proves its
         injected faults actually exercised this path."""
         if not self._classify(exc) or self._deadline.expired():
-            telemetry.incr(f"retry.fatal.{op}")
+            # Sidecar-namespace ops are expected-miss probes, not
+            # payload failures: the journal read at every take start
+            # 404s on a fresh path, and a ``retry.fatal.read`` counter
+            # for it reads as a payload-blob retry gone fatal in every
+            # stage_breakdown (the BENCH_r06 stray). Label them under
+            # their own family so the payload counters stay clean.
+            family = (
+                "retry.fatal.sidecar"
+                if path.startswith(SIDECAR_PREFIX)
+                else "retry.fatal"
+            )
+            telemetry.incr(f"{family}.{op}")
+            if family == "retry.fatal":
+                # Sidecar misses stay out of the black box too — a 404'd
+                # journal probe at take start is not forensic signal.
+                flight.record(
+                    "retry_fatal", op=op, path=path, error=type(exc).__name__
+                )
             raise exc
         telemetry.incr("retry.attempts")
         telemetry.incr(f"retry.transient.{op}.{type(exc).__name__}")
         telemetry.event(
             "retry", op=op, path=path, attempt=attempt, error=type(exc).__name__
+        )
+        flight.record(
+            "retry",
+            op=op,
+            path=path,
+            attempt=attempt,
+            error=type(exc).__name__,
         )
         logger.warning(
             "Transient storage error in %s(%r) (attempt %d): %s; retrying",

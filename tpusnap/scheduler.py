@@ -48,7 +48,7 @@ from typing import Callable, List, Optional, Set
 
 import psutil
 
-from . import telemetry
+from . import flight, telemetry
 from .io_types import (
     PROBE_DIR,
     ReadIO,
@@ -355,6 +355,12 @@ class _ProbeRunner:
         self.tele.record_span("probe_roofline", start, elapsed, **sample)
         telemetry.incr("probe.probes", rec=self.tele)
         telemetry.incr("probe.bytes_written", nbytes, rec=self.tele)
+        flight.record(
+            "probe",
+            write_gbps=sample["write_gbps"],
+            read_gbps=sample["read_gbps"],
+            bytes=nbytes,
+        )
 
 
 @dataclass

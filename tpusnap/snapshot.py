@@ -343,6 +343,12 @@ class Snapshot:
             # before the handle is returned.
             tele.meta["completed"] = True
             tele_commit.finish_progress()
+            # Final black-box flush with the committed verdict (the
+            # pump's last tick already flushed; this one is forced and
+            # carries the take_end event). Never raises.
+            from . import flight as _flight_mod
+
+            _flight_mod.recorder().end_take("committed")
             if comm.rank == 0:
                 # Metadata committed and every rank departed: the take
                 # journal's job is done. Best-effort — a crash before
@@ -757,6 +763,16 @@ class _TakeAbortContext:
                 self.progress.finish("aborted")
             except Exception:
                 pass
+        # The black box records the abort and force-flushes: an aborted
+        # take's forensic breadcrumb survives even though its blobs and
+        # journal are about to be cleaned.
+        try:
+            from . import flight as _flight_mod
+
+            _flight_mod.record("abort", op=type(exc).__name__)
+            _flight_mod.recorder().end_take("aborted")
+        except Exception:
+            pass
         if self.monitor is not None and not isinstance(exc, TakeAbortedError):
             self.monitor.publish(exc)
         keep_blobs = self.commit_started or (
@@ -1118,6 +1134,24 @@ def _take_impl(
             logger.warning(
                 "Failed to start progress monitor (non-fatal)", exc_info=True
             )
+    # Black-box flight recorder (tpusnap.flight): arm this take's
+    # crash-surviving flush destinations and piggyback the periodic
+    # flush on the heartbeat pump — from here, a SIGKILL loses at most
+    # one flush interval of events (`python -m tpusnap timeline`).
+    try:
+        from . import flight as _flight_mod
+        from .progress import local_root_of
+
+        _frec = _flight_mod.recorder()
+        _frec.configure_take(
+            rank, take_id, comm.world_size, path, local_root_of(path)
+        )
+        if progress_monitor is not None and _frec.enabled:
+            progress_monitor.add_tick_hook(_flight_mod.make_tick_hook(_frec))
+    except Exception:
+        logger.warning(
+            "Failed to configure flight recorder (non-fatal)", exc_info=True
+        )
 
     # Incremental snapshot: this rank's view of the base snapshot's
     # manifest, blob locations rewritten relative to the NEW root.
@@ -2168,6 +2202,9 @@ class PendingSnapshot(_BackgroundWork):
                 # Commit done: eligible for the cross-run history when
                 # _cleanup's end_take publishes the summary.
                 self._tele_commit.tele.meta["completed"] = True
+        from . import flight as _flight_mod
+
+        _flight_mod.recorder().end_take("committed")
         snapshot = Snapshot(self.path, self._storage_options, self._comm)
         if self._comm.rank == 0:
             snapshot._metadata = self._metadata

@@ -65,6 +65,7 @@ from .knobs import is_telemetry_enabled
 logger = logging.getLogger(__name__)
 
 from .io_types import TELEMETRY_DIR  # canonical sidecar path (io_types)
+from . import flight as _flight  # black-box event feed (span open/close)
 
 # Wall-clock seam: timestamps only (started_at); ALL duration math in
 # this file is monotonic — direct wall-clock CALLS are lint-forbidden
@@ -516,13 +517,18 @@ class TakeTelemetry:
         thread = threading.current_thread().name
         with self._lock:
             self._inflight[token] = (thread, name)
+        # Flight-recorder feed (span OPEN): an op that began but never
+        # ended is exactly what the post-mortem timeline must show.
+        _flight.record("op_begin", op=name)
         return token
 
     def op_exit(self, token: Optional[object]) -> None:
         if token is None:
             return
         with self._lock:
-            self._inflight.pop(token, None)
+            entry = self._inflight.pop(token, None)
+        if entry is not None:
+            _flight.record("op_end", op=entry[1])
 
     @contextmanager
     def op(self, name: str) -> Generator[None, None, None]:
@@ -539,6 +545,7 @@ class TakeTelemetry:
         """Record ``name`` as the most recently completed phase (called
         by :class:`PhaseMarker`); read by the heartbeat publisher."""
         self._last_phase = name
+        _flight.record("phase", op=name)
 
     def live_snapshot(self) -> Dict[str, Any]:
         """One consistent snapshot of the recorder's observable state
@@ -780,6 +787,13 @@ def begin_take(rank: int) -> TakeTelemetry:
     span()/incr()/event() without threading a handle."""
     global _global_current
     _begin_common()
+    # Fresh black box per take: the flight sidecar is a per-take
+    # artifact, and a crashed take's verdict must not count previous
+    # takes' stalls/evictions (restores do NOT reset — they overlay).
+    try:
+        _flight.recorder().mark_take_start()
+    except Exception:
+        logger.debug("flight ring reset failed", exc_info=True)
     rec = TakeTelemetry(rank)
     rec.meta["kind"] = "take"
     _global_current = rec
