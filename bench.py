@@ -932,6 +932,27 @@ def main() -> None:
         "pinned_host": pinned_host,
     }
 
+    # Checkpoint-SLO accuracy check (tpusnap.slo), free with every bench
+    # run: the RTO estimator grades itself against the restore this very
+    # run measured (the bench's own takes/restores fed history and the
+    # tracker's commit anchor above), and the realized commit interval
+    # rides along — `history --kind bench` then trends estimator drift.
+    try:
+        from tpusnap import slo as _slo
+
+        _est = _slo.estimate_rto(nbytes)
+        _slo_state = _slo.tracker().snapshot_state()
+        result["slo_estimated_rto_s"] = _est.seconds if _est.ok else None
+        result["slo_rto_actual_s"] = round(restore_el, 3)
+        result["slo_rto_ratio"] = (
+            round(_est.seconds / restore_el, 3)
+            if _est.ok and restore_el > 0
+            else None
+        )
+        result["slo_commit_interval_s"] = _slo_state.get("commit_interval_s")
+    except Exception:
+        pass
+
     # Record the headline trajectory into the same cross-run history the
     # takes/restores above already fed (kind="take"/"restore", first run
     # cold-tagged automatically) — BENCH_r*.json trajectories become
@@ -980,6 +1001,20 @@ def main() -> None:
                 "incremental_effective_gbps": result[
                     "incremental_effective_gbps"
                 ],
+                # Estimator-vs-measured: slo_rto_ratio near 1.0 means
+                # the RTO gauge can be trusted; `history --check --kind
+                # bench --metric slo_rto_actual_s` gates restore time
+                # upward like every other duration.
+                **{
+                    k: result[k]
+                    for k in (
+                        "slo_estimated_rto_s",
+                        "slo_rto_actual_s",
+                        "slo_rto_ratio",
+                        "slo_commit_interval_s",
+                    )
+                    if result.get(k) is not None
+                },
             }
         )
     except Exception:

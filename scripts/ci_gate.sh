@@ -15,11 +15,14 @@
 #   4. `tpusnap analyze --check` — performance doctor on the newest
 #      bench/CI snapshot (tail latency, stragglers, roofline), when
 #      one is available
-#   5. `tpusnap timeline` smoke — take → SIGKILL → timeline must honor
+#   5. `tpusnap slo --check` smoke — checkpoint-SLO gate exit contract:
+#      0 on a healthy fresh commit, 2 on a seeded stale-commit breach,
+#      3 on an empty telemetry dir (no records)
+#   6. `tpusnap timeline` smoke — take → SIGKILL → timeline must honor
 #      its exit contract: 0 on a committed path, post-mortem section +
 #      exit 4 on a torn one, exit 3 when no flight data exists
 #      (matching the trace/analyze zero-span contract)
-#   6. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
+#   7. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
 #      and/or `minio` binary is on PATH, run the `cloud_real` pytest
 #      marker against the real server processes (skipped silently
 #      when the binaries are absent)
@@ -41,17 +44,17 @@ cd "$(dirname "$0")/.."
 fail() { echo "ci_gate: FAIL — $1" >&2; exit "$2"; }
 
 # ---- 1. static analysis --------------------------------------------------
-echo "ci_gate: [1/6] lint --check (AST invariants)"
+echo "ci_gate: [1/7] lint --check (AST invariants)"
 env JAX_PLATFORMS=cpu python -m tpusnap lint --check
 rc=$?
 [ "$rc" -eq 0 ] || fail "tpusnap lint --check (rc=$rc)" "$rc"
 
 # ---- 2. tier-1 -----------------------------------------------------------
 if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
-    echo "ci_gate: [2/6] tier-1 tests"
+    echo "ci_gate: [2/7] tier-1 tests"
     rm -f /tmp/_t1.log
     # cloud_real excluded here: on a host with the server binaries the
-    # real-backend suite belongs to step 6, not inside the fast tier.
+    # real-backend suite belongs to step 7, not inside the fast tier.
     timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m 'not slow and not cloud_real' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
@@ -59,11 +62,11 @@ if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
     echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
     [ "$rc" -eq 0 ] || fail "tier-1 tests (rc=$rc)" "$rc"
 else
-    echo "ci_gate: [2/6] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
+    echo "ci_gate: [2/7] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
 fi
 
 # ---- 3. cross-run history gate ------------------------------------------
-echo "ci_gate: [3/6] history --check (throughput + p99 write latency)"
+echo "ci_gate: [3/7] history --check (throughput + p99 write latency)"
 for kind in take bench; do
     python -m tpusnap history --check --kind "$kind" \
         --metric throughput_gbps --metric storage_write_p99_s --json
@@ -78,7 +81,7 @@ done
 # ---- 4. analyze doctor on the latest snapshot ---------------------------
 SNAP="${1:-${TPUSNAP_CI_SNAPSHOT:-}}"
 if [ -n "$SNAP" ]; then
-    echo "ci_gate: [4/6] analyze --check $SNAP"
+    echo "ci_gate: [4/7] analyze --check $SNAP"
     python -m tpusnap analyze --check --history "$SNAP"
     rc=$?
     case "$rc" in
@@ -87,11 +90,68 @@ if [ -n "$SNAP" ]; then
         *) fail "analyze --check $SNAP (rc=$rc)" "$rc" ;;
     esac
 else
-    echo "ci_gate: [4/6] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
+    echo "ci_gate: [4/7] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
 fi
 
-# ---- 5. flight-recorder timeline smoke ----------------------------------
-echo "ci_gate: [5/6] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
+# ---- 5. checkpoint-SLO gate smoke ---------------------------------------
+echo "ci_gate: [5/7] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, shutil, subprocess, sys, tempfile, time
+
+work = tempfile.mkdtemp(prefix="tpusnap_ci_slo_")
+tele = os.path.join(work, "tele")
+# Hermetic like the timeline smoke: the takes here must not feed the
+# HOST history this gate's own step 3 grades.
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           TPUSNAP_TELEMETRY_DIR=tele, TPUSNAP_HISTORY="0")
+import atexit
+atexit.register(shutil.rmtree, work, True)
+
+def slo(*extra, tdir=tele):
+    e = dict(env, TPUSNAP_TELEMETRY_DIR=tdir)
+    return subprocess.run(
+        [sys.executable, "-m", "tpusnap", "slo", "--check", *extra],
+        capture_output=True, text=True, env=e, timeout=120,
+    )
+
+def die(msg):
+    print(f"slo smoke: FAIL - {msg}", file=sys.stderr)
+    sys.exit(1)
+
+# (a) empty telemetry dir -> exit 3
+r = slo(tdir=os.path.join(work, "empty"))
+if r.returncode != 3:
+    die(f"empty dir: expected exit 3, got {r.returncode}: {r.stderr[-300:]}")
+
+# (b) committed take -> healthy under a generous RPO threshold -> exit 0
+take = (
+    "import os; os.environ.setdefault('JAX_PLATFORMS','cpu');\n"
+    "import jax; jax.config.update('jax_platforms','cpu');\n"
+    "import numpy as np, sys\n"
+    "from tpusnap import Snapshot, StateDict\n"
+    "Snapshot.take(sys.argv[1], {'a': StateDict(w=np.arange(200000, dtype=np.float32))})\n"
+)
+subprocess.run([sys.executable, "-c", take, os.path.join(work, "snap")],
+               check=True, env=env, timeout=180)
+r = slo("--rpo", "3600")
+if r.returncode != 0:
+    die(f"healthy: expected exit 0, got {r.returncode}: {r.stdout[-300:]}{r.stderr[-300:]}")
+
+# (c) seeded stale commit -> breach -> exit 2
+rec_path = os.path.join(tele, "slo", "rank_0.json")
+rec = json.load(open(rec_path))
+rec["last_commit_ts"] = time.time() - 900  # 15 minutes stale
+json.dump(rec, open(rec_path, "w"))
+r = slo("--rpo", "60")
+if r.returncode != 2:
+    die(f"stale breach: expected exit 2, got {r.returncode}: {r.stdout[-300:]}")
+print("slo smoke: OK (3/3 contract legs)")
+PYEOF
+rc=$?
+[ "$rc" -eq 0 ] || fail "slo --check smoke (rc=$rc)" "$rc"
+
+# ---- 6. flight-recorder timeline smoke ----------------------------------
+echo "ci_gate: [6/7] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, signal, subprocess, sys, tempfile
 
@@ -164,9 +224,9 @@ PYEOF
 rc=$?
 [ "$rc" -eq 0 ] || fail "timeline smoke (rc=$rc)" "$rc"
 
-# ---- 6. optional real-backend cloud suite --------------------------------
+# ---- 7. optional real-backend cloud suite --------------------------------
 if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&1; then
-    echo "ci_gate: [6/6] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
+    echo "ci_gate: [7/7] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m cloud_real \
         -p no:cacheprovider -p no:xdist -p no:randomly
     rc=$?
@@ -176,7 +236,7 @@ if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&
         fail "real-backend cloud suite (rc=$rc)" "$rc"
     fi
 else
-    echo "ci_gate: [6/6] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
+    echo "ci_gate: [7/7] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
 fi
 
 echo "ci_gate: PASS"

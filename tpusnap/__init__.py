@@ -79,8 +79,20 @@ try:  # pragma: no cover - import surface grows as modules land
         merge_timeline,
         postmortem_verdict,
     )
+    from .slo import (  # noqa: F401
+        RTOEstimate,
+        SLOTracker,
+        estimate_rto,
+        read_slo_records,
+    )
+    from .slo import record_step as record_slo_step  # noqa: F401
 
     __all__ += [
+        "RTOEstimate",
+        "SLOTracker",
+        "estimate_rto",
+        "read_slo_records",
+        "record_slo_step",
         "FlightRecorder",
         "estimate_skew",
         "load_flight_logs",

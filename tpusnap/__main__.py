@@ -41,6 +41,7 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
   watch       PATH      tail an IN-FLIGHT take's heartbeat records
                         (.tpusnap/progress/rank_<k>.json) and render a
                         live per-rank table (phase, % bytes, MB/s,
+                        data-at-risk + time-since-last-commit exposure,
                         stragglers flagged), refreshing in place until
                         the take commits (``--once``/``--json`` for one
                         frame; exit 3 = no heartbeat records found)
@@ -87,6 +88,18 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
                         = committed, 4 = uncommitted post-mortem, 3 =
                         no flight data recorded)
 
+  slo                   checkpoint SLO state from this host's per-rank
+                        tracker sidecars (TPUSNAP_TELEMETRY_DIR/slo/):
+                        per-rank time-since-last-commit, data-at-risk
+                        bytes, history-derived estimated RTO, breach
+                        flags, and rank 0's fleet worst-case fold
+                        (``--json`` for machines; ``--check`` gates:
+                        exit 0 healthy, 2 when a set TPUSNAP_SLO_RPO_S
+                        / TPUSNAP_SLO_RTO_S threshold — or ``--rpo`` /
+                        ``--rto`` — is breached, 3 when no records
+                        exist or an RTO objective is set but no
+                        estimate could be formed)
+
   lint                  AST invariant checker over the package source
                         (``tpusnap/devtools/lint.py``): knob access only
                         through knobs.py, monotonic-only clocks,
@@ -101,11 +114,12 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
 
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
 (or provably-different diff; history --check: regression; analyze
---check: warn-severity finding), 3 undecidable/unverifiable (or no
-telemetry recorded — trace and analyze; no flight data — timeline;
-fsck: empty/foreign; history: no/insufficient events), 4 torn take
-(fsck — salvageable by retaking the path; timeline: uncommitted path,
-post-mortem verdict printed).
+--check: warn-severity finding; slo --check: SLO breach), 3
+undecidable/unverifiable (or no telemetry recorded — trace and analyze;
+no flight data — timeline; fsck: empty/foreign; history: no/
+insufficient events; slo: no records / no estimator verdict), 4 torn
+take (fsck — salvageable by retaking the path; timeline: uncommitted
+path, post-mortem verdict printed).
 """
 
 from __future__ import annotations
@@ -166,11 +180,18 @@ def cmd_info(args) -> int:
     print(f"version:     {md.version}")
     if md.created_at is not None:
         import datetime
+        import time as _time
 
         ts = datetime.datetime.fromtimestamp(
             md.created_at, tz=datetime.timezone.utc
         )
-        print(f"created:     {ts.isoformat(timespec='seconds')}")
+        # Snapshot age IS the recovery-point floor: a crash right now
+        # rewinds training at least this far.
+        age = max(_time.time() - md.created_at, 0.0)
+        print(
+            f"created:     {ts.isoformat(timespec='seconds')} "
+            f"({_fmt_age(age)} ago)"
+        )
     print(f"world_size:  {md.world_size}")
     print(f"payload:     {_fmt_bytes(total)}")
     print(f"entries:     {sum(counts.values())}")
@@ -231,6 +252,22 @@ def cmd_info(args) -> int:
                     f"({worst['skew']:.2f}x the p50) — "
                     "`trace` for the full breakdown"
                 )
+    # History-derived estimated restore time (the tpusnap.slo RTO
+    # estimator over the rank-0 restore view): "how long until training
+    # resumes from THIS snapshot" — best-effort, shown only when ≥3
+    # comparable restore events exist on this host.
+    try:
+        from .inspect import rank_payload_nbytes
+        from .slo import estimate_rto
+
+        est = estimate_rto(rank_payload_nbytes(md, 0))
+        if est.ok:
+            print(
+                f"est restore: {_fmt_seconds(est.seconds)} "
+                f"({est.reason}; `slo` for live exposure)"
+            )
+    except Exception:
+        pass
     return 0
 
 
@@ -1153,6 +1190,90 @@ def cmd_history(args) -> int:
     return 0
 
 
+def _fmt_age(s: float) -> str:
+    if s < 120:
+        return f"{s:.0f}s"
+    if s < 7200:
+        return f"{s / 60:.0f}m"
+    if s < 172800:
+        return f"{s / 3600:.1f}h"
+    return f"{s / 86400:.1f}d"
+
+
+def cmd_slo(args) -> int:
+    import json as _json
+
+    from .slo import evaluate_records, read_slo_records, slo_dir
+
+    directory = args.dir or slo_dir()
+    records = read_slo_records(directory)
+    report = evaluate_records(
+        records, rpo_threshold_s=args.rpo, rto_threshold_s=args.rto
+    )
+    if args.json:
+        print(_json.dumps({"dir": directory, **report}))
+    else:
+        print(f"slo dir:    {directory}")
+        th = report["thresholds"]
+        print(
+            "thresholds: "
+            f"rpo={'%gs' % th['rpo_s'] if th['rpo_s'] else 'unset'} "
+            f"rto={'%gs' % th['rto_s'] if th['rto_s'] else 'unset'} "
+            "(TPUSNAP_SLO_RPO_S / TPUSNAP_SLO_RTO_S, or --rpo/--rto)"
+        )
+        if report["ranks"]:
+            print(
+                f"\n{'rank':>4} {'since-commit':>13} {'at-risk':>10} "
+                f"{'est-RTO':>9} {'rec-age':>8}  breach"
+            )
+            for r in report["ranks"]:
+                flags = [
+                    k
+                    for k, on in (("RPO", r["breach_rpo"]),
+                                  ("RTO", r["breach_rto"]))
+                    if on
+                ]
+                rto = r.get("estimated_rto_s")
+                since = (
+                    _fmt_age(r["since_commit_s"])
+                    if r.get("committed")
+                    else f"{_fmt_age(r['since_commit_s'])}*"
+                )
+                print(
+                    f"{r['rank']:>4} {since:>13} "
+                    f"{_fmt_bytes(r['data_at_risk_bytes']):>10} "
+                    f"{(_fmt_seconds(rto) if rto is not None else '-'):>9} "
+                    f"{_fmt_age(r['record_age_s']):>8}  "
+                    f"{','.join(flags) or '-'}"
+                    + ("  (exited cleanly; exposure frozen)"
+                       if r.get("final") else "")
+                )
+            fleet = next(
+                (r["fleet"] for r in report["ranks"] if r.get("fleet")), None
+            )
+            if fleet:
+                print(
+                    f"fleet (rank 0 fold over {fleet.get('ranks')} rank(s)): "
+                    f"rpo {_fmt_age(fleet.get('rpo_s') or 0)}, "
+                    f"{_fmt_bytes(fleet.get('data_at_risk_bytes') or 0)} at "
+                    "risk"
+                )
+            if any(not r.get("committed") for r in report["ranks"]):
+                print("(* = no commit yet; exposure counted from tracker start)")
+        print(f"\n{report['verdict'].upper()}: {report['reason']}")
+    # Without records there is nothing to render in any mode (exit 3,
+    # like watch/trace). The 2-on-breach / 3-on-no-verdict legs are
+    # gate semantics and apply under --check only.
+    if not records:
+        return 3
+    if args.check:
+        if report["verdict"] == "breach":
+            return 2
+        if report["verdict"] == "insufficient":
+            return 3
+    return 0
+
+
 def cmd_cat(args) -> int:
     out = Snapshot(args.path).read_object(args.manifest_path)
     if isinstance(out, np.ndarray):
@@ -1420,6 +1541,36 @@ def main(argv=None) -> int:
     p.add_argument("--keep", type=int, required=True, metavar="N")
     p.add_argument("--dry-run", action="store_true")
     p.set_defaults(fn=cmd_retain)
+
+    p = sub.add_parser(
+        "slo",
+        help="checkpoint SLO state (per-rank time-since-commit, "
+        "data-at-risk, estimated RTO, breach flags); --check gates "
+        "(exit 2 breach / 3 no records or no estimator verdict)",
+    )
+    p.add_argument(
+        "--dir", default=None, metavar="DIR",
+        help="SLO sidecar directory (default: TPUSNAP_TELEMETRY_DIR/slo)",
+    )
+    p.add_argument(
+        "--rpo", type=float, default=None, metavar="S",
+        help="RPO threshold in seconds (default: TPUSNAP_SLO_RPO_S; "
+        "0/unset = no RPO objective)",
+    )
+    p.add_argument(
+        "--rto", type=float, default=None, metavar="S",
+        help="RTO threshold in seconds (default: TPUSNAP_SLO_RTO_S; "
+        "0/unset = no RTO objective)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="gate mode: exit 2 on a breached objective, 3 when no "
+        "records exist or an RTO objective has no estimate, 0 healthy",
+    )
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser(
         "lint",

@@ -17,9 +17,16 @@ from typing import Iterable, List
 from ..lint import Finding, LintContext, Rule, SourceFile
 from ._common import member_alias_names, module_alias_names
 
-# The monotonic-only modules (PR 2's invariant). Paths relative to the
-# package root.
-SCOPED_MODULES = {"telemetry.py", "progress.py", "history.py", "flight.py"}
+# The monotonic-only modules (PR 2's invariant; slo.py born under it —
+# RPO/interval math on a stepped wall clock would misreport exposure).
+# Paths relative to the package root.
+SCOPED_MODULES = {
+    "telemetry.py",
+    "progress.py",
+    "history.py",
+    "flight.py",
+    "slo.py",
+}
 
 
 class MonotonicClockRule(Rule):

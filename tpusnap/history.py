@@ -163,6 +163,19 @@ def event_from_summary(kind: str, summary: Dict[str, Any]) -> Dict[str, Any]:
         pw = (summary.get("probe") or {}).get("write_gbps_p50")
         if pw:
             ev["probe_write_gbps"] = pw
+    # Checkpoint-SLO section (tpusnap.slo, recorded at the commit
+    # anchor): realized commit interval, the interval's change bytes,
+    # and the estimated RTO at commit time. commit_interval_s is a
+    # *_s metric, so `history --check --metric slo.commit_interval_s`
+    # would gate it upward — but the flat copy below is what makes the
+    # top-level gate usable without dotted-path lookups.
+    slo = summary.get("slo")
+    if isinstance(slo, dict):
+        ev["slo"] = slo
+        if isinstance(slo.get("commit_interval_s"), (int, float)):
+            ev["commit_interval_s"] = round(float(slo["commit_interval_s"]), 3)
+        if isinstance(slo.get("estimated_rto_s"), (int, float)):
+            ev["estimated_rto_s"] = round(float(slo["estimated_rto_s"]), 3)
     return ev
 
 

@@ -165,6 +165,19 @@ def entry_nbytes(entry: Entry) -> int:
     return 0
 
 
+def rank_payload_nbytes(metadata, rank: int) -> int:
+    """Total payload bytes of one rank's RESTORE VIEW — what a recovery
+    of this snapshot actually reads. The one definition both SLO
+    surfaces share (the tracker's commit anchor and the CLI's estimated
+    restore time), so they cannot silently diverge."""
+    from .manifest_ops import get_manifest_for_rank
+
+    view = get_manifest_for_rank(metadata, rank)
+    return sum(
+        entry_nbytes(e) for e in view.values() if not is_container_entry(e)
+    )
+
+
 def _tensor_blobs(path: str, entry: TensorEntry, detail: str = "") -> Iterator[_Blob]:
     """Expand one TensorEntry into its verifiable ranges. Entries carrying
     tile-grain checksums are emitted per tile (so a scrub pinpoints the

@@ -49,6 +49,8 @@ _LOCKCHECK_ENV_VAR = "TPUSNAP_LOCKCHECK"
 _FLIGHT_ENV_VAR = "TPUSNAP_FLIGHT"
 _FLIGHT_RING_ENV_VAR = "TPUSNAP_FLIGHT_RING"
 _FLIGHT_FLUSH_ENV_VAR = "TPUSNAP_FLIGHT_FLUSH_S"
+_SLO_RPO_ENV_VAR = "TPUSNAP_SLO_RPO_S"
+_SLO_RTO_ENV_VAR = "TPUSNAP_SLO_RTO_S"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -439,6 +441,26 @@ def get_flight_flush_interval_s() -> float:
     return max(0.02, val)
 
 
+def get_slo_rpo_threshold_s() -> float:
+    """Recovery-point objective threshold (:mod:`tpusnap.slo`): when
+    the seconds since the last committed take exceed this, the tracker
+    emits one edge-triggered ``slo_breach`` flight event + counter per
+    episode, the breach flag rides the exported gauges/sidecar, and
+    ``python -m tpusnap slo --check`` exits 2. ``0`` (the default)
+    means no RPO objective is set — the gauges still publish."""
+    return max(0.0, _get_float_env(_SLO_RPO_ENV_VAR, 0.0))
+
+
+def get_slo_rto_threshold_s() -> float:
+    """Recovery-time objective threshold (:mod:`tpusnap.slo`): breach
+    when the history-derived estimated restore time of the last
+    committed snapshot exceeds this many seconds. ``0`` (the default)
+    = unset. The estimate needs ≥3 comparable restore events in
+    ``history.jsonl``; with a threshold set and no estimate available,
+    ``slo --check`` exits 3 (no verdict), never a silent pass."""
+    return max(0.0, _get_float_env(_SLO_RTO_ENV_VAR, 0.0))
+
+
 def is_lockcheck_enabled() -> bool:
     """Runtime lock-order watchdog (:mod:`tpusnap.devtools.lockwatch`),
     OPT-IN via ``TPUSNAP_LOCKCHECK=1``: every ``threading.Lock``/
@@ -637,6 +659,20 @@ def override_flight_ring_size(n: int) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_flight_flush_interval_s(seconds: float) -> Generator[None, None, None]:
     with _override_env(_FLIGHT_FLUSH_ENV_VAR, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def override_slo_thresholds(
+    rpo_s: Optional[float] = None, rto_s: Optional[float] = None
+) -> Generator[None, None, None]:
+    """Override the SLO breach thresholds in one scope (None leaves the
+    corresponding env var untouched)."""
+    with contextlib.ExitStack() as stack:
+        if rpo_s is not None:
+            stack.enter_context(_override_env(_SLO_RPO_ENV_VAR, str(rpo_s)))
+        if rto_s is not None:
+            stack.enter_context(_override_env(_SLO_RTO_ENV_VAR, str(rto_s)))
         yield
 
 

@@ -97,6 +97,15 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
       quantiles (``quantile="0.5|0.95|0.99"``, ``plugin=<class>``) from
       the process-global log2 histograms recorded at the
       storage-plugin boundary.
+    - ``tpusnap_rpo_seconds``, ``tpusnap_data_at_risk_bytes``,
+      ``tpusnap_estimated_rto_seconds``,
+      ``tpusnap_commit_interval_seconds`` — checkpoint-SLO gauges
+      (:mod:`tpusnap.slo`), refreshed at heartbeat cadence while a
+      take runs and at every commit; rank 0 of a multi-process take
+      additionally exports the fleet worst-case as ``scope="fleet"``
+      samples. ``tpusnap_slo_breach`` is 1 while a set
+      ``TPUSNAP_SLO_RPO_S``/``TPUSNAP_SLO_RTO_S`` threshold is
+      crossed (``objective`` label).
     - ``tpusnap_last_summary_timestamp_seconds`` — staleness probe.
     """
 
@@ -109,6 +118,7 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
         self._last_wall: Dict[str, float] = {}
         self._summary_counts: Dict[str, int] = {}
         self._last_gauges: Dict[str, float] = {}
+        self._slo_state: Optional[Dict[str, Any]] = None
         self._rank: Optional[int] = None
 
     # --- MetricsSink ----------------------------------------------------
@@ -118,6 +128,15 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
 
     def on_restore_summary(self, summary: Dict[str, Any]) -> None:
         self._absorb("restore", summary)
+
+    def on_slo_update(self, state: Dict[str, Any]) -> None:
+        # Same locked write+rename discipline as _absorb: the SLO
+        # publisher runs on the heartbeat pump thread while summaries
+        # publish from commit threads; the per-pid temp name is shared.
+        with self._lock:
+            self._slo_state = dict(state)
+            self._rank = state.get("rank", self._rank or 0)
+            self._rewrite_locked()
 
     # --- internals ------------------------------------------------------
 
@@ -147,13 +166,16 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
                 v = (summary.get("gauges") or {}).get(g)
                 if v is not None:
                     self._last_gauges[g] = float(v)
-            text = self.render()
-            path = self.path(self._rank)
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                f.write(text)
-            os.replace(tmp, path)
+            self._rewrite_locked()
+
+    def _rewrite_locked(self) -> None:
+        text = self.render()
+        path = self.path(self._rank if self._rank is not None else 0)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
 
     def render(self) -> str:
         """The full exposition text from current state (process-global
@@ -286,6 +308,63 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
                 "gauge",
                 "Peak RSS delta sampled over the last take/restore.",
                 [({}, self._last_gauges["peak_rss_delta_bytes"])],
+            )
+        # Checkpoint-SLO gauges (tpusnap.slo): the per-rank view, plus
+        # rank 0's fleet worst-case fold as scope="fleet" samples.
+        slo = self._slo_state
+        if slo is not None:
+            fleet = slo.get("fleet") or {}
+
+            def slo_samples(key: str) -> List[Tuple[Dict[str, str], float]]:
+                samples: List[Tuple[Dict[str, str], float]] = []
+                v = slo.get(key)
+                if isinstance(v, (int, float)):
+                    samples.append(({}, float(v)))
+                fv = fleet.get(key)
+                if isinstance(fv, (int, float)):
+                    samples.append(({"scope": "fleet"}, float(fv)))
+                return samples
+
+            for key, mname, help_ in (
+                (
+                    "rpo_s",
+                    "tpusnap_rpo_seconds",
+                    "Seconds since the last committed take (recovery-"
+                    "point exposure; fleet scope = worst rank).",
+                ),
+                (
+                    "data_at_risk_bytes",
+                    "tpusnap_data_at_risk_bytes",
+                    "Bytes mutated since the last committed take (best "
+                    "evidence tier: explicit steps / incremental change "
+                    "stats / planned payload).",
+                ),
+                (
+                    "estimated_rto_s",
+                    "tpusnap_estimated_rto_seconds",
+                    "History-derived estimated restore wall-clock of "
+                    "the last committed snapshot.",
+                ),
+                (
+                    "commit_interval_s",
+                    "tpusnap_commit_interval_seconds",
+                    "Monotonic interval between the last two commits "
+                    "(the realized RPO of the closed interval).",
+                ),
+            ):
+                samples = slo_samples(key)
+                if samples:
+                    metric(mname, "gauge", help_, samples)
+            breach = slo.get("breach") or {}
+            metric(
+                "tpusnap_slo_breach",
+                "gauge",
+                "1 while a set TPUSNAP_SLO_RPO_S/RTO_S threshold is "
+                "crossed, by objective.",
+                [
+                    ({"objective": k}, 1.0 if breach.get(k) else 0.0)
+                    for k in ("rpo", "rto")
+                ],
             )
         metric(
             "tpusnap_last_summary_timestamp_seconds",

@@ -80,6 +80,16 @@ def progress_rank_path(rank: int) -> str:
     return f"{PROGRESS_DIR}/rank_{rank}.json"
 
 
+def _fmt_short_bytes(n) -> str:
+    """Compact byte figure for the watch table's at-risk column."""
+    n = float(n)
+    for unit in ("B", "K", "M", "G", "T"):
+        if n < 1024 or unit == "T":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}T"
+
+
 def local_root_of(path: str) -> Optional[str]:
     """The local directory a snapshot URL writes into, or None for
     non-local backends (heartbeat files and ``watch`` are local-fs
@@ -161,9 +171,17 @@ class ProgressMonitor:
         self._attributions = list(attributions or [])
         # Piggyback hooks run once per tick with the freshly built
         # progress record (or None when the throttle skipped building
-        # one) — the flight recorder's flush rides here so its cadence
-        # shares this pump thread instead of owning another.
+        # one) — the flight recorder's flush and the SLO tracker's
+        # publisher ride here so their cadence shares this pump thread
+        # instead of owning another.
         self._tick_hooks: List[Callable[[Optional[Dict[str, Any]]], None]] = []
+        # Optional small-dict provider folded into every published
+        # record under "slo" (tpusnap.slo): time-since-commit and
+        # data-at-risk ride the same heartbeat `watch` and the fleet
+        # fold already read.
+        self._slo_provider: Optional[Callable[[], Optional[Dict[str, Any]]]] = (
+            None
+        )
         self._clock = clock
         self._wall = wall_clock
         self._state = "running"
@@ -205,6 +223,14 @@ class ProgressMonitor:
         Exceptions are swallowed per hook — the pump must survive any
         subscriber."""
         self._tick_hooks.append(fn)
+
+    def set_slo_provider(
+        self, fn: Callable[[], Optional[Dict[str, Any]]]
+    ) -> None:
+        """Register the SLO field provider (see ``_slo_provider``).
+        Exceptions are swallowed — exposure accounting must never fail
+        a heartbeat."""
+        self._slo_provider = fn
 
     # --- the pump -------------------------------------------------------
 
@@ -405,6 +431,13 @@ class ProgressMonitor:
         # achievable instead of a bare number.
         if snap.get("probe_write_gbps"):
             rec["probe_write_gbps"] = snap["probe_write_gbps"]
+        if self._slo_provider is not None:
+            try:
+                slo = self._slo_provider()
+                if slo:
+                    rec["slo"] = slo
+            except Exception:
+                logger.debug("slo provider failed", exc_info=True)
         return rec
 
     # --- lifecycle ------------------------------------------------------
@@ -546,11 +579,14 @@ def render_watch_table(
 ) -> str:
     """One frame of the ``tpusnap watch`` table. ``stall_flag_s`` flags
     ranks whose heartbeat has not advanced for that long (record
-    beat_age plus how stale the record itself is)."""
+    beat_age plus how stale the record itself is). The ``at-risk`` /
+    ``commit`` columns (from the record's ``slo`` sub-dict) show
+    EXPOSURE — bytes a crash right now would lose, and how long since
+    the last committed take — alongside progress."""
     now = _wall() if now is None else now
     lines = [
         f"{'rank':>4}  {'state':<10} {'phase':<16} {'op':<20} "
-        f"{'%':>6} {'MB/s':>8} {'beat':>7}"
+        f"{'%':>6} {'MB/s':>8} {'at-risk':>8} {'commit':>8} {'beat':>7}"
     ]
     for r in records:
         staleness = max(0.0, now - r.get("ts", now))
@@ -566,11 +602,18 @@ def render_watch_table(
         mbps = r.get("mbps", 0.0)
         if ceiling and mbps:
             flag = f"  ({min(mbps / (ceiling * 1e3), 9.99):.0%} of ceiling)" + flag
+        slo = r.get("slo") or {}
+        at_risk = slo.get("data_at_risk_bytes")
+        at_risk_str = _fmt_short_bytes(at_risk) if at_risk is not None else "-"
+        # Time since the last COMMIT advances even while the record is
+        # stale — exposure grows in real time, unlike progress.
+        rpo = slo.get("rpo_s")
+        commit_str = f"{rpo + staleness:.0f}s" if rpo is not None else "-"
         lines.append(
             f"{r.get('rank', '?'):>4}  {r.get('state', '?'):<10} "
             f"{(r.get('phase') or '-'):<16.16} {(r.get('op') or '-'):<20.20} "
             f"{(f'{pct:.1f}' if pct is not None else '-'):>6} "
-            f"{mbps:>8.1f} {age:>6.1f}s{flag}"
+            f"{mbps:>8.1f} {at_risk_str:>8} {commit_str:>8} {age:>6.1f}s{flag}"
         )
     if not records:
         lines.append("(no heartbeat records yet)")

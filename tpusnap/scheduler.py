@@ -448,6 +448,15 @@ class _WritePipeline:
         if buf is SKIP_WRITE:
             self.skipped = True
             telemetry.incr("scheduler.dedup_skipped", rec=self.tele)
+            # Byte-grain leg of the skip counter: the dual-hash pass
+            # proved these planned payload bytes unchanged against the
+            # base — the SLO tracker's data-at-risk accounting subtracts
+            # them live (tpusnap.slo).
+            telemetry.incr(
+                "scheduler.dedup_skipped_bytes",
+                self.write_req.buffer_stager.get_planned_bytes(),
+                rec=self.tele,
+            )
             return self
         self.buf = buf
         self.buf_size = (

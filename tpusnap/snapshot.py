@@ -339,9 +339,13 @@ class Snapshot:
             comm.barrier()
             # Commit is definitive: mark the take completed (end_take
             # publishes only completed takes to the cross-run history),
-            # publish the final heartbeat (100%) and stop the pump
-            # before the handle is returned.
+            # anchor the SLO tracker (RPO clock restarts, data-at-risk
+            # clears), publish the final heartbeat (100%) and stop the
+            # pump before the handle is returned.
             tele.meta["completed"] = True
+            _record_slo_commit(
+                tele, metadata, tele_commit.take_id, path, comm.rank
+            )
             tele_commit.finish_progress()
             # Final black-box flush with the committed verdict (the
             # pump's last tick already flushed; this one is forced and
@@ -763,6 +767,16 @@ class _TakeAbortContext:
                 self.progress.finish("aborted")
             except Exception:
                 pass
+        # SLO bookkeeping: release the dead take's telemetry record
+        # (its counters would otherwise stay referenced for the process
+        # lifetime) WITHOUT clearing the exposure — nothing committed,
+        # so the planned bytes are still at risk.
+        try:
+            from . import slo as _slo
+
+            _slo.tracker().note_take_aborted()
+        except Exception:
+            pass
         # The black box records the abort and force-flushes: an aborted
         # take's forensic breadcrumb survives even though its blobs and
         # journal are about to be cleaned.
@@ -1152,6 +1166,23 @@ def _take_impl(
         logger.warning(
             "Failed to configure flight recorder (non-fatal)", exc_info=True
         )
+    # Checkpoint-SLO tracker (tpusnap.slo): the exposure gauges (RPO,
+    # data-at-risk, estimated RTO) publish at the heartbeat cadence on
+    # the same pump thread, and the slo sub-dict rides every heartbeat
+    # record (what `watch`'s at-risk column and rank 0's fleet fold
+    # read). Best-effort like everything observability.
+    if progress_monitor is not None:
+        try:
+            from . import slo as _slo
+
+            _slo.tracker().refresh_rto()
+            _slo.attach_to_take(
+                progress_monitor, take_id, rank, comm.world_size
+            )
+        except Exception:
+            logger.warning(
+                "Failed to attach SLO tracker (non-fatal)", exc_info=True
+            )
 
     # Incremental snapshot: this rank's view of the base snapshot's
     # manifest, blob locations rewritten relative to the NEW root.
@@ -1229,6 +1260,9 @@ def _take_impl(
         # (dedup-skipped paths are never written; deleting them is a
         # harmless no-op failure).
         abort_ctx.write_paths = [wr.path for wr in write_reqs]
+    planned_payload = sum(
+        wr.buffer_stager.get_planned_bytes() for wr in write_reqs
+    )
     if progress_monitor is not None:
         # Denominator of the heartbeat's byte progress — PAYLOAD bytes,
         # not staging cost (async array clones charge 2x cost; dividing
@@ -1236,9 +1270,34 @@ def _take_impl(
         # Dedup/salvage skips make written < planned, so the committed
         # record forces 100% (the mid-flight figure is best-effort by
         # design).
-        progress_monitor.set_bytes_planned(
-            sum(wr.buffer_stager.get_planned_bytes() for wr in write_reqs)
+        progress_monitor.set_bytes_planned(planned_payload)
+    # Data-at-risk floor (tpusnap.slo): everything this take stages is
+    # at risk until its commit clears it; incremental takes refine the
+    # figure live from the dual-hash skip counters. Recorded even with
+    # telemetry off (the tracker is bookkeeping, not spans).
+    try:
+        from . import slo as _slo
+
+        _rec = mark.rec
+        # Identity must not depend on the telemetry knob: attach (the
+        # tick-hook wiring) is skipped when the pump is off, but the
+        # sidecar/commit bookkeeping still runs per rank.
+        _slo.tracker().configure(rank, comm.world_size)
+        _slo.tracker().note_planned(
+            planned_payload,
+            incremental=incremental_from is not None,
+            live_counters=(
+                (lambda: _rec.live_snapshot()["counters"])
+                if _rec is not None
+                else None
+            ),
+            # The capture anchor: this take's commit makes THIS
+            # instant's state durable — not the (possibly minutes
+            # later) commit instant.
+            take_id=take_id,
         )
+    except Exception:
+        logger.debug("slo note_planned failed", exc_info=True)
 
     # Non-incremental takes hash on the WRITE path instead of the
     # staging window (see ArrayBufferStager.defer_checksums) — the hash
@@ -1817,6 +1876,43 @@ class _TelemetryCommit:
                 pass
 
 
+def _record_slo_commit(
+    tele: Optional[telemetry.TakeTelemetry],
+    metadata: SnapshotMetadata,
+    take_id: Optional[str],
+    path: str,
+    rank: int,
+) -> None:
+    """Anchor the checkpoint-SLO tracker on a definitive commit (both
+    commit paths call this strictly after the metadata write, right
+    where ``completed`` is set): close the interval, clear the
+    data-at-risk accumulators, refresh the RTO estimate against THIS
+    RANK's restore view bytes (what a recovery would actually read),
+    and fold the compact ``slo`` section into the summary the history
+    event records. Best-effort — never fails a take."""
+    try:
+        from . import slo as _slo
+        from .inspect import rank_payload_nbytes
+
+        snapshot_bytes = rank_payload_nbytes(metadata, rank)
+        counters: Dict[str, int] = {}
+        incremental = False
+        if tele is not None:
+            counters = tele.live_snapshot()["counters"]
+            incremental = bool(tele.meta.get("incremental"))
+        section = _slo.tracker().record_commit(
+            take_id or "",
+            path,
+            snapshot_bytes,
+            incremental=incremental,
+            counters=counters,
+        )
+        if tele is not None:
+            tele.meta["slo"] = section
+    except Exception:
+        logger.debug("slo commit record failed", exc_info=True)
+
+
 def _write_metadata(
     storage: StoragePlugin,
     metadata: SnapshotMetadata,
@@ -2197,11 +2293,19 @@ class PendingSnapshot(_BackgroundWork):
         except Exception:
             pass
         if self._tele_commit is not None:
-            self._tele_commit.finish_progress()
             if self._tele_commit.tele is not None:
                 # Commit done: eligible for the cross-run history when
-                # _cleanup's end_take publishes the summary.
+                # _cleanup's end_take publishes the summary, and the
+                # SLO tracker's RPO clock re-anchors here.
                 self._tele_commit.tele.meta["completed"] = True
+            _record_slo_commit(
+                self._tele_commit.tele,
+                self._metadata,
+                self._tele_commit.take_id,
+                self.path,
+                self._comm.rank,
+            )
+            self._tele_commit.finish_progress()
         from . import flight as _flight_mod
 
         _flight_mod.recorder().end_take("committed")

@@ -110,6 +110,12 @@ class MetricsSink:
     def on_restore_summary(self, summary: Dict[str, Any]) -> None:
         pass
 
+    def on_slo_update(self, state: Dict[str, Any]) -> None:
+        """Checkpoint-SLO state refresh (:mod:`tpusnap.slo`): RPO,
+        data-at-risk, estimated RTO, commit interval — pushed at
+        heartbeat cadence while a take runs and at every commit."""
+        pass
+
 
 _sinks: Tuple[MetricsSink, ...] = ()
 _sinks_lock = threading.Lock()
@@ -173,6 +179,13 @@ def _notify(method: str, *args) -> None:
                     method,
                     exc_info=True,
                 )
+
+
+def notify_slo_update(state: Dict[str, Any]) -> None:
+    """Fan one SLO state refresh out to every registered sink (the
+    :mod:`tpusnap.slo` publisher's sink leg; same swallow/rate-limit
+    contract as every other callback)."""
+    _notify("on_slo_update", state)
 
 
 # ---------------------------------------------------- global counters
