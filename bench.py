@@ -57,8 +57,14 @@ result is interpretable on any disk:
 
 - ``incremental_take_s`` / ``incremental_effective_gbps``: an
   ``incremental_from=`` take of the UNCHANGED state against the last
-  snapshot — all blobs dedup, so the cost is one CRC pass and no
-  storage I/O (~9-10 GB/s effective on this host).
+  snapshot — all blobs dedup, so the cost is one fused CRC32C+XXH64
+  pass and no storage I/O.
+- ``delta_rpo_seconds`` / ``delta_write_amplification`` /
+  ``delta_commit_overhead_s``: a short ``Snapshot.stream`` soak over a
+  training loop mutating ~1/64 of one array per step — the realized
+  steady-state RPO (max interval between micro-commits vs the
+  configured cadence), delta bytes written over bytes actually
+  mutated, and the per-micro-commit capture cost.
 - ``scrub_gbps`` / ``scrub_clean``: ``verify_snapshot`` re-reading and
   checksum-verifying every stored byte — full-scale ABSOLUTES, with
   an engine comparator (``scrub_roofline_gbps``: the exact byte
@@ -569,6 +575,43 @@ def main() -> None:
         shutil.rmtree(os.path.join(bench_root, "inc_base"), ignore_errors=True)
         shutil.rmtree(os.path.join(bench_root, "inc"), ignore_errors=True)
 
+        # Delta-mode section (tpusnap.delta): a short stream over a
+        # "training loop" mutating ~1/64 of one array per step. Records
+        # the steady-state realized RPO (max commit interval), delta
+        # write amplification (delta bytes / changed bytes) and
+        # per-micro-commit overhead — the numbers `history --check
+        # --kind bench` regression-gates for the streaming mode.
+        from tpusnap import slo as _slo_mod
+
+        delta_root = os.path.join(bench_root, "delta_stream")
+        d_state = {"model": PytreeState({"w0": state["w0"]})}
+        d_arr = state["w0"].view(np.uint16)
+        rows = d_arr.shape[0]
+        delta_cadence_s = 0.5
+        changed_bytes_total = 0
+        stream = Snapshot.stream(
+            delta_root, d_state, cadence_s=delta_cadence_s
+        )
+        t0 = time.perf_counter()
+        step = 0
+        while time.perf_counter() - t0 < 6.0:
+            lo = (step * rows // 64) % rows
+            hi = min(lo + rows // 64, rows)
+            d_arr[lo:hi] ^= 1
+            changed_bytes_total += d_arr[lo:hi].nbytes
+            stream.mark_step(bytes_changed=int(d_arr[lo:hi].nbytes))
+            step += 1
+            time.sleep(0.01)
+        stream.close(final_commit=False)
+        delta_stats = dict(stream.stats)
+        delta_rpo_s = _slo_mod.tracker().rpo_s()
+        delta_write_amp = (
+            delta_stats["bytes_written_total"] / changed_bytes_total
+            if changed_bytes_total
+            else None
+        )
+        shutil.rmtree(delta_root, ignore_errors=True)
+
         # Scrub, interleaved with its own roofline: the exact byte ranges
         # the scrub verifies, read through the same native fused read+CRC
         # engine at the same concurrency, zero manifest/asyncio machinery.
@@ -906,6 +949,23 @@ def main() -> None:
         "incremental_effective_gbps": round(
             nbytes / inc_take_s / 1e9, 3
         ),
+        # Delta streaming mode (tpusnap.delta): realized RPO in the
+        # steady state (max interval between micro-commits — the
+        # headline the stream exists to shrink; configured cadence
+        # alongside for the ratio), write amplification (delta bytes
+        # written / bytes actually mutated; tile-grain dedup keeps it
+        # ~1), and per-micro-commit overhead (the dual-hash pass +
+        # changed-tile writes).
+        "delta_cadence_s": delta_cadence_s,
+        "delta_commits": delta_stats["commits"],
+        "delta_rpo_seconds": delta_stats["max_commit_interval_s"],
+        "delta_rpo_at_close_s": round(delta_rpo_s, 3),
+        "delta_write_amplification": (
+            round(delta_write_amp, 3) if delta_write_amp else None
+        ),
+        "delta_commit_overhead_s": delta_stats["last_commit_wall_s"],
+        "delta_bytes_written": delta_stats["bytes_written_total"],
+        "delta_compactions": delta_stats["compactions"],
         "scrub_s": round(scrub_s, 2),
         "scrub_gbps": round(scrub_bytes / scrub_s / 1e9, 3),
         "scrub_roofline_gbps": round(scrub_roofline, 3),
@@ -1001,6 +1061,19 @@ def main() -> None:
                 "incremental_effective_gbps": result[
                     "incremental_effective_gbps"
                 ],
+                # Streaming-mode regression feed: `history --check
+                # --kind bench --metric delta_rpo_seconds` gates the
+                # realized RPO upward like any duration, and the
+                # amplification/overhead columns trend alongside.
+                **{
+                    k: result[k]
+                    for k in (
+                        "delta_rpo_seconds",
+                        "delta_write_amplification",
+                        "delta_commit_overhead_s",
+                    )
+                    if result.get(k) is not None
+                },
                 # Estimator-vs-measured: slo_rto_ratio near 1.0 means
                 # the RTO gauge can be trusted; `history --check --kind
                 # bench --metric slo_rto_actual_s` gates restore time

@@ -18,11 +18,18 @@
 #   5. `tpusnap slo --check` smoke — checkpoint-SLO gate exit contract:
 #      0 on a healthy fresh commit, 2 on a seeded stale-commit breach,
 #      3 on an empty telemetry dir (no records)
-#   6. `tpusnap timeline` smoke — take → SIGKILL → timeline must honor
+#   6. delta soak smoke — `Snapshot.stream` against a training loop
+#      for ~30 s with TPUSNAP_SLO_RPO_S armed: `tpusnap slo --check`
+#      must exit 0 and the measured steady-state RPO (max micro-commit
+#      interval) must be ≤ 2x the configured cadence; then a second
+#      soak is SIGKILLed inside a micro-commit and the torn tail must
+#      honor the chain exit contracts (member fsck exit 4 naming the
+#      torn delta micro-commit, root fsck exit 4, timeline exit 4/3)
+#   7. `tpusnap timeline` smoke — take → SIGKILL → timeline must honor
 #      its exit contract: 0 on a committed path, post-mortem section +
 #      exit 4 on a torn one, exit 3 when no flight data exists
 #      (matching the trace/analyze zero-span contract)
-#   7. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
+#   8. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
 #      and/or `minio` binary is on PATH, run the `cloud_real` pytest
 #      marker against the real server processes (skipped silently
 #      when the binaries are absent)
@@ -44,17 +51,17 @@ cd "$(dirname "$0")/.."
 fail() { echo "ci_gate: FAIL — $1" >&2; exit "$2"; }
 
 # ---- 1. static analysis --------------------------------------------------
-echo "ci_gate: [1/7] lint --check (AST invariants)"
+echo "ci_gate: [1/8] lint --check (AST invariants)"
 env JAX_PLATFORMS=cpu python -m tpusnap lint --check
 rc=$?
 [ "$rc" -eq 0 ] || fail "tpusnap lint --check (rc=$rc)" "$rc"
 
 # ---- 2. tier-1 -----------------------------------------------------------
 if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
-    echo "ci_gate: [2/7] tier-1 tests"
+    echo "ci_gate: [2/8] tier-1 tests"
     rm -f /tmp/_t1.log
     # cloud_real excluded here: on a host with the server binaries the
-    # real-backend suite belongs to step 7, not inside the fast tier.
+    # real-backend suite belongs to step 8, not inside the fast tier.
     timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m 'not slow and not cloud_real' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
@@ -62,11 +69,11 @@ if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
     echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
     [ "$rc" -eq 0 ] || fail "tier-1 tests (rc=$rc)" "$rc"
 else
-    echo "ci_gate: [2/7] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
+    echo "ci_gate: [2/8] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
 fi
 
 # ---- 3. cross-run history gate ------------------------------------------
-echo "ci_gate: [3/7] history --check (throughput + p99 write latency)"
+echo "ci_gate: [3/8] history --check (throughput + p99 write latency)"
 for kind in take bench; do
     python -m tpusnap history --check --kind "$kind" \
         --metric throughput_gbps --metric storage_write_p99_s --json
@@ -81,7 +88,7 @@ done
 # ---- 4. analyze doctor on the latest snapshot ---------------------------
 SNAP="${1:-${TPUSNAP_CI_SNAPSHOT:-}}"
 if [ -n "$SNAP" ]; then
-    echo "ci_gate: [4/7] analyze --check $SNAP"
+    echo "ci_gate: [4/8] analyze --check $SNAP"
     python -m tpusnap analyze --check --history "$SNAP"
     rc=$?
     case "$rc" in
@@ -90,11 +97,11 @@ if [ -n "$SNAP" ]; then
         *) fail "analyze --check $SNAP (rc=$rc)" "$rc" ;;
     esac
 else
-    echo "ci_gate: [4/7] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
+    echo "ci_gate: [4/8] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
 fi
 
 # ---- 5. checkpoint-SLO gate smoke ---------------------------------------
-echo "ci_gate: [5/7] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
+echo "ci_gate: [5/8] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, subprocess, sys, tempfile, time
 
@@ -150,8 +157,152 @@ PYEOF
 rc=$?
 [ "$rc" -eq 0 ] || fail "slo --check smoke (rc=$rc)" "$rc"
 
-# ---- 6. flight-recorder timeline smoke ----------------------------------
-echo "ci_gate: [6/7] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
+# ---- 6. delta soak smoke -------------------------------------------------
+echo "ci_gate: [6/8] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, re, shutil, signal, subprocess, sys, tempfile, time
+
+work = tempfile.mkdtemp(prefix="tpusnap_ci_delta_")
+tele = os.path.join(work, "tele")
+# Hermetic observability (see the slo/timeline smokes) + the RPO
+# objective ARMED for the whole soak: a healthy stream must never
+# breach it, and `slo --check` reads the same env threshold.
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           TPUSNAP_TELEMETRY_DIR=tele, TPUSNAP_HISTORY="0",
+           TPUSNAP_SLO_RPO_S="10",
+           TPUSNAP_HEARTBEAT_INTERVAL_S="0.05")
+import atexit
+atexit.register(shutil.rmtree, work, True)
+
+def die(msg):
+    print(f"delta soak: FAIL - {msg}", file=sys.stderr)
+    sys.exit(1)
+
+CADENCE = 1.0
+_SOAK = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from tpusnap import Snapshot, StateDict
+
+root, duration, cadence, kill_mode = (
+    sys.argv[1], float(sys.argv[2]), float(sys.argv[3]), sys.argv[4]
+)
+if kill_mode == "kill":
+    # Make the torn window deterministic: the first payload write into
+    # a delta member past seq 1 announces itself and lingers, so the
+    # parent's SIGKILL always lands inside a micro-commit.
+    import tpusnap.storage_plugins.fs as fs_mod
+    orig_write = fs_mod.FSStoragePlugin.write
+    fired = [False]
+    async def hooked(self, write_io):
+        root_s = getattr(self, "root", "")
+        if (not fired[0] and "delta-0000" in root_s
+                and not root_s.endswith("delta-000001")
+                and not write_io.path.startswith(".tpusnap")):
+            fired[0] = True
+            print("MARK", flush=True)
+            time.sleep(2.0)
+        await orig_write(self, write_io)
+    fs_mod.FSStoragePlugin.write = hooked
+
+state = {"m": StateDict(w=np.zeros((512, 512), np.float32), step=0)}
+stream = Snapshot.stream(root, state, cadence_s=cadence)
+t0, k = time.monotonic(), 0
+while time.monotonic() - t0 < duration:
+    k += 1
+    state["m"]["w"][k % 512, :] = float(k)
+    state["m"]["step"] = k
+    stream.mark_step(bytes_changed=2048)
+    time.sleep(0.01)
+stream.close()
+stream.raise_if_failed()
+print("STATS " + json.dumps(stream.stats), flush=True)
+"""
+
+# (a) healthy ~30 s soak: clean close, slo --check green, measured
+# steady-state RPO (max micro-commit interval) <= 2x cadence.
+root = os.path.join(work, "stream")
+r = subprocess.run(
+    [sys.executable, "-c", _SOAK, root, "30", str(CADENCE), "run"],
+    capture_output=True, text=True, env=env, timeout=240,
+)
+if r.returncode != 0:
+    die(f"soak child failed rc={r.returncode}: {r.stdout[-400:]}{r.stderr[-400:]}")
+m = re.search(r"STATS (\{.*\})", r.stdout)
+if not m:
+    die(f"soak printed no stats: {r.stdout[-400:]}")
+stats = json.loads(m.group(1))
+if stats["commits"] < 3:
+    die(f"soak produced only {stats['commits']} micro-commit(s)")
+rpo = stats.get("max_commit_interval_s")
+if rpo is None or rpo > 2 * CADENCE:
+    die(f"measured RPO {rpo}s exceeds 2x cadence ({2 * CADENCE}s)")
+r = subprocess.run(
+    [sys.executable, "-m", "tpusnap", "slo", "--check"],
+    capture_output=True, text=True, env=env, timeout=120,
+)
+if r.returncode != 0:
+    die(f"slo --check after soak: expected 0, got {r.returncode}: "
+        f"{r.stdout[-300:]}")
+print(f"delta soak: healthy leg OK ({stats['commits']} commits, "
+      f"max interval {rpo}s <= {2 * CADENCE}s, slo --check green)")
+
+# (b) SIGKILL inside a micro-commit -> torn-tail exit contracts.
+root2 = os.path.join(work, "stream_kill")
+proc = subprocess.Popen(
+    [sys.executable, "-c", _SOAK, root2, "60", "0.4", "kill"],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    start_new_session=True,
+)
+buf, deadline = "", time.monotonic() + 120
+while time.monotonic() < deadline and "MARK" not in buf:
+    line = proc.stdout.readline()
+    if line == "":
+        break
+    buf += line
+if "MARK" not in buf:
+    os.killpg(proc.pid, signal.SIGKILL); proc.wait(timeout=60)
+    die(f"kill soak never reached the write window: {buf[-400:]}")
+time.sleep(0.3)
+os.killpg(proc.pid, signal.SIGKILL)
+proc.wait(timeout=60)
+
+def cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tpusnap", *args],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+
+torn = sorted(
+    d for d in os.listdir(root2)
+    if d.startswith("delta-")
+    and not os.path.exists(os.path.join(root2, d, ".snapshot_metadata"))
+)
+if not torn:
+    die(f"SIGKILL left no torn member under {root2}: {os.listdir(root2)}")
+member = os.path.join(root2, torn[-1])
+r = cli("fsck", member)
+if r.returncode != 4:
+    die(f"member fsck: expected 4 (torn), got {r.returncode}: {r.stdout[-300:]}")
+if "torn delta micro-commit" not in r.stdout:
+    die(f"member fsck does not name the torn delta state: {r.stdout[-300:]}")
+r = cli("fsck", root2)
+if r.returncode != 4:
+    die(f"root fsck: expected 4 (torn tail), got {r.returncode}: {r.stdout[-300:]}")
+r = cli("timeline", member)
+if r.returncode not in (3, 4):
+    die(f"timeline on torn member: expected 4 (or 3 pre-flush), got "
+        f"{r.returncode}: {r.stderr[-300:]}")
+print("delta soak: OK (healthy RPO leg + torn-tail contract leg)")
+PYEOF
+rc=$?
+[ "$rc" -eq 0 ] || fail "delta soak smoke (rc=$rc)" "$rc"
+
+# ---- 7. flight-recorder timeline smoke ----------------------------------
+echo "ci_gate: [7/8] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, signal, subprocess, sys, tempfile
 
@@ -224,9 +375,9 @@ PYEOF
 rc=$?
 [ "$rc" -eq 0 ] || fail "timeline smoke (rc=$rc)" "$rc"
 
-# ---- 7. optional real-backend cloud suite --------------------------------
+# ---- 8. optional real-backend cloud suite --------------------------------
 if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&1; then
-    echo "ci_gate: [7/7] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
+    echo "ci_gate: [8/8] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m cloud_real \
         -p no:cacheprovider -p no:xdist -p no:randomly
     rc=$?
@@ -236,7 +387,7 @@ if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&
         fail "real-backend cloud suite (rc=$rc)" "$rc"
     fi
 else
-    echo "ci_gate: [7/7] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
+    echo "ci_gate: [8/8] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
 fi
 
 echo "ci_gate: PASS"

@@ -846,3 +846,216 @@ def test_abort_propagates_across_ranks(tmp_path):
     )
     assert any("ABORT_OK" in o for o in outs), outs
     assert any("REUSE_OK" in o for o in outs), outs
+
+
+# ------------------------------------------------ delta-stream windows
+
+
+_DELTA_CHILD = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["TPUSNAP_DISABLE_BATCHING"] = "1"
+os.environ["TPUSNAP_HEARTBEAT_INTERVAL_S"] = "0.05"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+window, root, seed = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+_WINDOW_SLEEP = 1.2
+
+def mark_and_linger():
+    print("MARK", flush=True)
+    time.sleep(_WINDOW_SLEEP)
+
+import tpusnap.storage_plugins.fs as fs_mod
+import tpusnap.inspect as inspect_mod
+from tpusnap import Snapshot, StateDict
+
+if window == "delta_micro":
+    # SIGKILL inside a micro-commit's storage write, after >= 1 delta
+    # already committed (so recovery lands on a delta, not the base).
+    orig_write = fs_mod.FSStoragePlugin.write
+    fired = [False]
+    async def hooked(self, write_io):
+        root_s = getattr(self, "root", "")
+        if (
+            not fired[0]
+            and "delta-0000" in root_s
+            and not root_s.endswith("delta-000001")
+            and not write_io.path.startswith(".tpusnap")
+        ):
+            fired[0] = True
+            mark_and_linger()
+        await orig_write(self, write_io)
+    fs_mod.FSStoragePlugin.write = hooked
+elif window == "delta_compact":
+    orig_mat = inspect_mod.materialize_snapshot
+    def hooked_mat(*a, **kw):
+        mark_and_linger()
+        return orig_mat(*a, **kw)
+    inspect_mod.materialize_snapshot = hooked_mat
+elif window != "delta_between":
+    raise SystemExit(f"unknown window {window}")
+
+# Self-describing deterministic state: pattern(seed) + step. The
+# parent recomputes the expected arrays for ANY committed step k and
+# asserts the replayed restore is bit-identical.
+pattern = (
+    np.random.default_rng(seed).standard_normal((256, 256)).astype(np.float32)
+)
+state = {"app": StateDict(w=pattern.copy(), step=0)}
+max_chain = 2 if window == "delta_compact" else 100
+stream = Snapshot.stream(root, state, cadence_s=3600, max_chain=max_chain)
+for k in range(1, 8):
+    state["app"]["w"] = pattern + np.float32(k)
+    state["app"]["step"] = k
+    stream.commit_now()
+    print(f"COMMIT {stream.seq} {k}", flush=True)
+    if window == "delta_between" and k == 3:
+        mark_and_linger()
+print("DONE", flush=True)
+stream.close(final_commit=False)
+"""
+
+
+def _run_delta_window(tmp_path, window: str, seed: int) -> None:
+    """SIGKILL a delta stream inside ``window``; assert the chain's
+    crash contract: fsck classifies every member, `timeline` names the
+    in-flight delta state of a torn tail, and replaying base +
+    committed chain restores BIT-IDENTICALLY to the last committed
+    micro-commit's reference state (never older than one commit)."""
+    import re
+    import select
+
+    root = str(tmp_path / "stream")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _DELTA_CHILD, window, root, str(seed)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        buf = ""
+        deadline = time.monotonic() + 120
+        marked = eof = False
+        while time.monotonic() < deadline and not marked and not eof:
+            ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+            if not ready:
+                continue
+            chunk = os.read(proc.stdout.fileno(), 4096).decode(
+                "utf-8", errors="replace"
+            )
+            if chunk == "":
+                eof = True
+                break
+            buf += chunk
+            marked = "MARK" in buf
+        if not marked:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=60)
+            pytest.fail(
+                f"child never reached window {window!r} (eof={eof}): "
+                f"{buf[-2000:]}"
+            )
+        kill_jitter_s = random.Random(seed).uniform(0.0, 0.8)
+        time.sleep(kill_jitter_s)
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+
+    from tpusnap import resolve_chain
+    from tpusnap.lifecycle import fsck_snapshot
+
+    # The child printed "COMMIT <seq> <step>" after each completed
+    # commit; recovery must land at least there.
+    committed_steps = [
+        int(m.group(2)) for m in re.finditer(r"COMMIT (\d+) (\d+)", buf)
+    ]
+    last_printed_step = committed_steps[-1] if committed_steps else 0
+
+    rep = resolve_chain(root)
+    assert rep.head is not None, (window, seed, buf[-500:], rep.summary())
+    head_path = rep.head_path
+
+    # 1. Replay restore is bit-identical to the last committed
+    # micro-commit's reference state (self-describing: step rides the
+    # snapshot, so an unprinted trailing commit verifies too).
+    pattern = (
+        np.random.default_rng(seed)
+        .standard_normal((256, 256))
+        .astype(np.float32)
+    )
+    target = {
+        "app": StateDict(w=np.zeros((256, 256), np.float32), step=-1)
+    }
+    Snapshot(head_path).restore(target)
+    k = target["app"]["step"]
+    assert k >= last_printed_step, (
+        f"recovery lost a committed micro-commit: restored step {k} < "
+        f"last printed {last_printed_step}"
+    )
+    expected = pattern + np.float32(k) if k > 0 else pattern
+    assert np.array_equal(target["app"]["w"], expected), (window, seed, k)
+    assert verify_snapshot(head_path).clean, (window, seed)
+
+    # 2. fsck classification of every member + the torn tail contract.
+    head_report = fsck_snapshot(head_path)
+    assert head_report.state == "committed", head_report.summary()
+    assert head_report.delta is not None, head_report.summary()
+    if rep.torn_tail:
+        torn_path = os.path.join(root, rep.torn_tail)
+        torn_report = fsck_snapshot(torn_path)
+        assert torn_report.state == "torn", torn_report.summary()
+        assert torn_report.delta is not None, (
+            "torn tail lost its chain membership",
+            torn_report.summary(),
+        )
+        assert "torn delta micro-commit" in torn_report.summary()
+        # 3. `timeline` names the in-flight delta state (exit 4 =
+        # torn-path post-mortem; 3 = killed before the first flight
+        # flush, the documented no-data leg).
+        rc, doc = _timeline_json(torn_path)
+        assert rc in (3, 4), (window, seed, rc)
+        if rc == 4:
+            assert (doc or {}).get("delta"), doc
+            assert doc["delta"].get("seq") is not None, doc
+    # Root-level fsck honors the chain exit contract.
+    from tpusnap.__main__ import main as _main
+
+    import contextlib
+    import io
+
+    with contextlib.redirect_stdout(io.StringIO()):
+        rc = _main(["fsck", root])
+    assert rc == (4 if rep.torn_tail else 0), (window, seed, rc)
+
+
+@pytest.mark.soak
+@pytest.mark.parametrize(
+    "window", ["delta_micro", "delta_between", "delta_compact"]
+)
+@pytest.mark.parametrize("seed", range(2))
+def test_delta_crash_matrix(tmp_path, window, seed):
+    """SIGKILL inside a micro-commit, between micro-commits, and
+    mid-chain-compaction (tier-1 fast seeds)."""
+    _run_delta_window(tmp_path, window, seed)
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "window", ["delta_micro", "delta_between", "delta_compact"]
+)
+@pytest.mark.parametrize("seed", range(2, 10))
+def test_delta_crash_matrix_seed_sweep(tmp_path, window, seed):
+    _run_delta_window(tmp_path, window, seed)

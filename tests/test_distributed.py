@@ -753,16 +753,30 @@ def test_multihost_simulated_budget_divisor():
 def _world_late_checksums(snap_dir):
     """Multi-process deferred checksums: the committed metadata carries
     every rank's checksums (hashed on the write path, transported via
-    the commit barrier's KV store), a non-leader rank's returned handle
-    verifies clean (it reads the committed file rather than its stale
-    in-memory gather), and the take-scoped KV keys are DELETED after
-    the commit — one leaked blob per rank per take would grow the
+    the commit barrier's KV store), EVERY rank's returned handle caches
+    a fully-patched metadata (non-leaders apply the same KV patch to
+    their local copies — ADVICE r5 #4 — instead of re-reading the
+    committed file), and the take-scoped KV keys are DELETED after the
+    final barrier — one leaked blob per rank per take would grow the
     coordination service for the job's lifetime."""
     import numpy as np
 
+    import tpusnap.snapshot as snap_mod
     from tpusnap import Snapshot, StateDict
     from tpusnap.comm import get_communicator
     from tpusnap.snapshot import _get_kv_store
+
+    # The deferral path actually ENGAGED: a regression to eager hashing
+    # would make every later assertion here pass vacuously, so count
+    # the KV publishes the deferral transport performs.
+    publishes = []
+    orig_publish = snap_mod._LateChecksums.publish
+
+    def counting_publish(self):
+        publishes.append(1)
+        return orig_publish(self)
+
+    snap_mod._LateChecksums.publish = counting_publish
 
     comm = get_communicator()
     rank = comm.rank
@@ -771,18 +785,21 @@ def _world_late_checksums(snap_dir):
         small=np.ones(32, np.float32) * rank,
     )
     snap = Snapshot.take(snap_dir, {"app": state})
-    # The deferral path actually ENGAGED: take withholds the cached
-    # in-memory metadata on non-leaders exactly when _LateChecksums is
-    # active — without this, a regression to eager hashing would make
-    # every later assertion here pass vacuously.
-    assert (snap._metadata is None) == (rank != 0), rank
-    # Every rank's handle — leader or not — sees full checksums.
+    assert publishes, "late-checksum deferral did not engage"
+    # Every rank — leader or not — caches fully-patched metadata: the
+    # non-leader's IN-MEMORY manifest carries every rank's checksums
+    # without a metadata GET (its cached copy was patched from the KV).
+    assert snap._metadata is not None, rank
+    for key in (f"{r}/app/w" for r in range(comm.world_size)):
+        assert snap._metadata.manifest[key].checksum is not None, (rank, key)
+    # Every rank's handle verifies clean.
     report = snap.verify()
     assert report.clean, (rank, report.summary())
     manifest = Snapshot(snap_dir).metadata.manifest
     for key in (f"{r}/app/w" for r in range(comm.world_size)):
         assert manifest[key].checksum is not None, key
-    # The late-checksum KV keys were cleaned up by rank 0's apply.
+    # The late-checksum KV keys were cleaned up by rank 0 after the
+    # final barrier (every rank had read them by then).
     comm.barrier()
     store = _get_kv_store(comm)
     leftovers = store.try_get_dir("tpusnap_late_cs/")
@@ -793,7 +810,9 @@ def _world_late_checksums(snap_dir):
     # Async path: same properties.
     pending = Snapshot.async_take(snap_dir + "_a", {"app": state})
     snap2 = pending.wait()
-    assert (snap2._metadata is None) == (rank != 0), rank
+    assert snap2._metadata is not None, rank
+    for key in (f"{r}/app/w" for r in range(comm.world_size)):
+        assert snap2._metadata.manifest[key].checksum is not None, (rank, key)
     assert snap2.verify().clean, rank
     comm.barrier()
     leftovers = store.try_get_dir("tpusnap_late_cs/")

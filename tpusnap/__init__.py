@@ -32,6 +32,11 @@ try:  # pragma: no cover - import surface grows as modules land
         Snapshot,
         load_snapshot,
     )
+    from .delta import (  # noqa: F401
+        DeltaChainReport,
+        DeltaStream,
+        resolve_chain,
+    )
     from .host_offload import (  # noqa: F401
         is_host_resident,
         supports_host_offload,
@@ -127,6 +132,9 @@ try:  # pragma: no cover - import surface grows as modules land
         "PendingSnapshot",
         "PendingRestore",
         "load_snapshot",
+        "DeltaStream",
+        "DeltaChainReport",
+        "resolve_chain",
         "Stateful",
         "AppState",
         "StateDict",

@@ -164,10 +164,68 @@ def _entry_desc(entry) -> str:
     return entry.type
 
 
+def _print_chain_report(rep) -> int:
+    """Render a delta-stream root (``resolve_chain``): member table,
+    recovery head, torn tail. Exit 0 with a head, 3 with no members."""
+    import datetime
+
+    print(f"path:        {rep.root}")
+    print("stream root: "
+          f"{len(rep.members)} member(s), chain depth {len(rep.chain)}")
+    for m in rep.members:
+        mark = (
+            "HEAD" if m.name == rep.head
+            else "TORN" if m.state == "torn"
+            else "????" if m.state == "debris"
+            else "    "
+        )
+        when = (
+            datetime.datetime.fromtimestamp(
+                m.created_at, tz=datetime.timezone.utc
+            ).isoformat(timespec="seconds")
+            if m.created_at
+            else "-"
+        )
+        seq = f"seq {m.seq}" if m.seq is not None else "  -  "
+        print(
+            f"  {mark}  {m.name:<16s} {m.state:<10s} {seq:<8s} "
+            f"{_fmt_bytes(m.payload_bytes):>10s}  {when}"
+        )
+    if rep.head:
+        print(f"recovery:    restore {rep.head_path} "
+              f"(replays {' + '.join(reversed(rep.chain))})")
+    if rep.torn_tail:
+        print(
+            f"torn tail:   {rep.torn_tail} — a micro-commit was "
+            "interrupted; recovery IGNORES it (`fsck`/`timeline` the "
+            "member for the post-mortem, retake or `gc --torn` to "
+            "reclaim)"
+        )
+    if rep.superseded:
+        print(
+            f"superseded:  {', '.join(rep.superseded)} (not referenced "
+            "by the head — reclaimable via retention)"
+        )
+    if rep.debris:
+        print(f"debris:      {', '.join(rep.debris)} (half-retired "
+              "member dir(s) — reclaim manually)")
+    return 0 if rep.head else 3
+
+
 def cmd_info(args) -> int:
     from .inspect import iter_blobs
 
-    md = Snapshot(args.path).metadata
+    try:
+        md = Snapshot(args.path).metadata
+    except RuntimeError:
+        # Not a snapshot dir itself — a delta-stream ROOT holds chain
+        # members one level down; render the chain view instead.
+        from .delta import resolve_chain
+
+        rep = resolve_chain(args.path)
+        if rep.members:
+            return _print_chain_report(rep)
+        raise
     counts: dict = {}
     total = 0
     for p, e in md.manifest.items():
@@ -193,6 +251,17 @@ def cmd_info(args) -> int:
             f"({_fmt_age(age)} ago)"
         )
     print(f"world_size:  {md.world_size}")
+    from .delta import delta_fields
+
+    dfields = delta_fields(md)
+    if dfields:
+        parent = dfields.get("parent")
+        print(
+            f"delta:       micro-commit seq {dfields.get('seq')} of "
+            f"stream {str(dfields.get('stream'))[:8]}"
+            + (f", parent {parent}" if parent else " (stream base)")
+            + " — `info` the stream root for the chain view"
+        )
     print(f"payload:     {_fmt_bytes(total)}")
     print(f"entries:     {sum(counts.values())}")
     for t, c in sorted(counts.items()):
@@ -378,6 +447,18 @@ def cmd_fsck(args) -> int:
     from .lifecycle import fsck_snapshot
 
     report = fsck_snapshot(args.path)
+    if report.state in ("foreign", "empty"):
+        # Not a take dir itself — a delta-stream ROOT holds classifiable
+        # members one level down: fan the classification out per member
+        # and grade the chain (torn tail → 4, healthy head → 0).
+        from .delta import resolve_chain
+
+        rep = resolve_chain(args.path)
+        if any(m.seq is not None for m in rep.members):
+            rc = _print_chain_report(rep)
+            if rep.torn_tail:
+                return 4
+            return rc
     print(report.summary())
     if report.journal is not None and report.state == "torn":
         import datetime
@@ -388,6 +469,12 @@ def cmd_fsck(args) -> int:
         print(f"  take started: {ts.isoformat(timespec='seconds')}")
         if report.journal.incremental_from:
             print(f"  incremental_from: {report.journal.incremental_from}")
+        if report.delta:
+            print(
+                f"  delta: torn micro-commit seq {report.delta.get('seq')} "
+                f"over {report.delta.get('parent')!r} — recovery lands on "
+                "the last committed increment (`fsck` the stream root)"
+            )
     if args.verbose:
         for p in report.missing_referenced:
             print(f"MISSING  {p}")
@@ -949,6 +1036,7 @@ def cmd_timeline(args) -> int:
                 {
                     "path": args.path,
                     "state": report.state,
+                    "delta": report.delta,
                     "ranks": sorted(logs),
                     "skew": {str(r): s for r, s in sorted(skew.items())},
                     "events": shown,
@@ -959,6 +1047,19 @@ def cmd_timeline(args) -> int:
     else:
         print(f"path:   {args.path}")
         print(f"state:  {report.state} (fsck)")
+        if report.delta:
+            parent = report.delta.get("parent")
+            print(
+                f"delta:  micro-commit seq {report.delta.get('seq')} of "
+                f"stream {str(report.delta.get('stream'))[:8]}"
+                + (f" over {parent}" if parent else "")
+                + (
+                    " — IN FLIGHT when the lights went out; recovery "
+                    "lands on the last committed increment"
+                    if report.state == "torn"
+                    else ""
+                )
+            )
         print(f"ranks:  {sorted(logs)} with flight data")
         multi = len(logs) > 1
         if multi:
@@ -1257,6 +1358,20 @@ def cmd_slo(args) -> int:
                     f"rpo {_fmt_age(fleet.get('rpo_s') or 0)}, "
                     f"{_fmt_bytes(fleet.get('data_at_risk_bytes') or 0)} at "
                     "risk"
+                )
+            cadence = next(
+                (
+                    r["stream_cadence_s"]
+                    for r in report["ranks"]
+                    if r.get("stream_cadence_s")
+                ),
+                None,
+            )
+            if cadence:
+                print(
+                    f"stream:     delta stream active, cadence {cadence:g}s "
+                    "— micro-commits anchor the RPO (expect since-commit "
+                    "≤ ~2x cadence)"
                 )
             if any(not r.get("committed") for r in report["ranks"]):
                 print("(* = no commit yet; exposure counted from tracker start)")

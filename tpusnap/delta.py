@@ -1,0 +1,903 @@
+"""Continuous delta checkpointing: streaming micro-commits for
+seconds-scale RPO with crash-replay restore (ROADMAP 4).
+
+A classic take is a periodic stop-the-world event: a crash loses
+everything since the last one — minutes of work at fleet cadences, and
+the PR 10 SLO tracker can only *measure* that exposure. This module
+composes primitives the system already owns — incremental dedup's
+dual-hash (CRC32C+XXH64) change detection, strict-staging incremental
+``async_take``, the crash-safe journal, salvage-resume and fsck's
+torn-tail classification — into a **streaming delta mode** with a
+tunable recovery-point objective:
+
+- :meth:`tpusnap.Snapshot.stream` opens a :class:`DeltaStream` under a
+  root directory: one full **base** snapshot now (with per-tile dedup
+  hashes recorded, so every blob has tile grain from the first
+  increment), then one **micro-commit** per cadence interval — a real,
+  journaled, metadata-written-last incremental snapshot referencing the
+  previous committed member, shipping only tiles/blobs whose fresh
+  dual-hash pair changed. An unchanged model streams ~zero payload
+  bytes; one mutated row of a multi-GB array streams ~one checksum
+  tile.
+- Because incremental writers **collapse chained references** (each
+  member's external locations point at the member that physically holds
+  the bytes — never through an intermediate), the chain never deepens
+  lookups: ``Snapshot(head).restore`` / ``read_object`` work
+  transparently on any member, reading base + changed blobs flat.
+- Every micro-commit runs the unchanged crash machinery: a SIGKILL
+  mid-commit leaves a **torn tail** the journal classifies (fsck names
+  it "torn delta micro-commit seq N over member X"), gc'd or salvaged
+  like any torn take — and recovery lands on the last committed
+  increment via :func:`resolve_chain`. Each commit also anchors the SLO
+  tracker, turning ``tpusnap_rpo_seconds`` from take-interval minutes
+  into stream-cadence seconds.
+- Chains stay bounded: past ``TPUSNAP_DELTA_MAX_CHAIN`` members the
+  stream **compacts** — ``materialize`` copies the head's referenced
+  blobs in (checksum-verified, committed atomically), making it the new
+  self-contained base, and the superseded members are retired.
+
+Step-consistency contract (the ``staged()``/mutate-after-return
+contract, streamed):
+
+- **Functional JAX updates** (the normal case) never need coordination:
+  the capture stages from the array objects it was handed; new arrays
+  produced by a later step are different objects.
+- **In-place mutators** (raw numpy buffers, donated pinned_host) call
+  :meth:`DeltaStream.mark_step` once per training step. The stream then
+  defers each due capture to the next ``mark_step`` call and performs
+  it inline there — on the training thread, at a step boundary — so no
+  capture ever overlaps a mutation. The capture cost is the strict
+  incremental staging window (the dual-hash pass; writes and the
+  two-phase commit drain on the background thread). Free-running
+  captures (no ``mark_step`` caller) run entirely on the stream's
+  worker thread and guarantee blob-grain consistency only.
+- :meth:`DeltaStream.commit_now` forces a synchronous micro-commit and
+  returns the committed :class:`~tpusnap.Snapshot`;
+  :meth:`DeltaStream.close` stops the stream (with a final commit by
+  default).
+
+Multi-process streams are not yet supported (cadence agreement and
+background state_dict capture across ranks need their own coordination
+protocol); ``world_size > 1`` raises. Single-process covers the
+serving/fine-tune fleets this mode targets first; multi-host training
+keeps explicit ``take``/``async_take``.
+"""
+
+from __future__ import annotations
+
+import logging
+import posixpath
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+from urllib.parse import urlsplit
+
+from . import flight, telemetry
+from .comm import Communicator, get_communicator
+from .knobs import get_delta_cadence_s, get_delta_max_chain
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "DeltaStream",
+    "DeltaChainReport",
+    "ChainMember",
+    "resolve_chain",
+    "delta_payload_bytes",
+]
+
+
+def member_name(seq: int) -> str:
+    """Canonical member directory name: ``base-000000`` for the stream's
+    first full snapshot, ``delta-%06d`` for micro-commits. Chain
+    structure is read from metadata (``extras["delta"]``), never parsed
+    from names — a compacted head keeps its ``delta-*`` name while
+    being fully self-contained."""
+    return f"base-{seq:06d}" if seq == 0 else f"delta-{seq:06d}"
+
+
+def delta_fields(metadata) -> Optional[Dict[str, Any]]:
+    """The validated delta-chain fields of a committed snapshot's
+    metadata — delegates to :func:`tpusnap.manifest_ops.
+    delta_chain_fields`, the one place chain membership is decoded."""
+    from .manifest_ops import delta_chain_fields
+
+    return delta_chain_fields(metadata)
+
+
+def delta_payload_bytes(metadata) -> int:
+    """Bytes PHYSICALLY stored in this member's own directory — i.e.
+    excluding external (``../``) references into earlier chain members.
+    The numerator of delta write amplification: for an unchanged model
+    this is ~zero; for one changed row of a tiled array it is ~one
+    checksum tile."""
+    from .inspect import iter_blobs
+
+    total = 0
+    for blob in iter_blobs(metadata.manifest):
+        if blob.location.startswith("../"):
+            continue
+        if blob.byte_range is not None:
+            total += blob.byte_range[1] - blob.byte_range[0]
+    return total
+
+
+# -------------------------------------------------------- chain resolution
+
+
+@dataclass
+class ChainMember:
+    """One directory under a stream root, classified."""
+
+    name: str
+    state: str  # "committed" | "torn" | "debris"
+    seq: Optional[int] = None
+    parent: Optional[str] = None
+    stream_id: Optional[str] = None
+    created_at: Optional[float] = None
+    payload_bytes: int = 0
+
+
+@dataclass
+class DeltaChainReport:
+    """What :func:`resolve_chain` finds under a stream root.
+
+    ``head`` is the RECOVERY POINT: the committed member with the
+    highest sequence number — ``Snapshot(<root>/<head>).restore``
+    replays base + committed deltas transparently. ``torn_tail`` names
+    a member whose micro-commit was interrupted (journal present, no
+    metadata): recovery IGNORES it (gc or the next stream's
+    salvage-resume reclaims it). ``chain`` is the set of members the
+    head's blob references actually span (head first) — what retention
+    must keep alive for the head to stay restorable. ``superseded`` are
+    committed members outside every live chain (compaction leftovers) —
+    reclaimable. ``debris`` are half-deleted/foreign subdirectories
+    (e.g. a compaction retire interrupted mid-rmtree)."""
+
+    root: str
+    members: List[ChainMember] = field(default_factory=list)
+    head: Optional[str] = None  # member name
+    torn_tail: Optional[str] = None
+    chain: List[str] = field(default_factory=list)  # head first
+    superseded: List[str] = field(default_factory=list)
+    debris: List[str] = field(default_factory=list)
+
+    @property
+    def head_path(self) -> Optional[str]:
+        return f"{self.root.rstrip('/')}/{self.head}" if self.head else None
+
+    def summary(self) -> str:
+        if not self.members:
+            return f"{self.root}: no delta-stream members"
+        s = (
+            f"{self.root}: {len(self.members)} member(s), "
+            f"head={self.head or 'NONE'}"
+        )
+        if self.chain:
+            s += f", chain depth {len(self.chain)}"
+        if self.torn_tail:
+            s += f", TORN TAIL {self.torn_tail} (recovery ignores it)"
+        if self.superseded:
+            s += f", {len(self.superseded)} superseded"
+        if self.debris:
+            s += f", {len(self.debris)} debris dir(s)"
+        return s
+
+
+def resolve_chain(
+    root: str, storage_options: Optional[Dict[str, Any]] = None
+) -> DeltaChainReport:
+    """Scan a stream root and name the recovery head, the torn tail (if
+    a crash interrupted a micro-commit) and the live chain. Read-only;
+    works on any backend that can list. Exposed through
+    ``python -m tpusnap info|fsck <root>`` when the root itself holds no
+    ``.snapshot_metadata`` but contains chain members."""
+    import asyncio
+
+    from .io_types import ReadIO
+    from .lifecycle import JOURNAL_FNAME, JOURNAL_RECORDS_DIR
+    from .manifest import decode_metadata
+    from .snapshot import SNAPSHOT_METADATA_FNAME
+    from .storage_plugin import url_to_storage_plugin_in_event_loop
+
+    report = DeltaChainReport(root=root)
+    event_loop = asyncio.new_event_loop()
+    try:
+        storage = url_to_storage_plugin_in_event_loop(
+            root, event_loop, storage_options
+        )
+        try:
+            files = storage.sync_list_with_sizes(event_loop)
+            if not files:
+                return report
+            # Group by first path component: each member is a subdir.
+            by_member: Dict[str, Dict[str, int]] = {}
+            for path, size in files.items():
+                member, sep, rest = path.partition("/")
+                if sep:
+                    by_member.setdefault(member, {})[rest] = size
+            for name in sorted(by_member):
+                sub = by_member[name]
+                m = ChainMember(name=name, state="debris")
+                if SNAPSHOT_METADATA_FNAME in sub:
+                    read_io = ReadIO(
+                        path=f"{name}/{SNAPSHOT_METADATA_FNAME}"
+                    )
+                    try:
+                        storage.sync_read(read_io, event_loop)
+                        md = decode_metadata(read_io.buf.getvalue())
+                    except Exception:
+                        report.members.append(m)
+                        report.debris.append(name)
+                        continue
+                    m.state = "committed"
+                    m.created_at = md.created_at
+                    d = delta_fields(md)
+                    if d is not None:
+                        m.seq = d.get("seq")
+                        m.parent = d.get("parent")
+                        m.stream_id = d.get("stream")
+                    try:
+                        m.payload_bytes = delta_payload_bytes(md)
+                    except Exception:
+                        pass
+                elif JOURNAL_FNAME in sub or any(
+                    p.startswith(JOURNAL_RECORDS_DIR + "/") for p in sub
+                ):
+                    m.state = "torn"
+                    read_io = ReadIO(path=f"{name}/{JOURNAL_FNAME}")
+                    try:
+                        from .lifecycle import TakeJournal
+
+                        storage.sync_read(read_io, event_loop)
+                        j = TakeJournal.from_json(
+                            read_io.buf.getvalue().decode("utf-8")
+                        )
+                        if j.stream:
+                            m.seq = j.stream.get("seq")
+                            m.parent = j.stream.get("parent")
+                            m.stream_id = j.stream.get("stream")
+                    except Exception:
+                        pass
+                else:
+                    report.debris.append(name)
+                report.members.append(m)
+        finally:
+            storage.sync_close(event_loop)
+    finally:
+        event_loop.close()
+
+    committed = [m for m in report.members if m.state == "committed"]
+    chain_members = [m for m in committed if m.seq is not None]
+    if chain_members:
+        head = max(
+            chain_members, key=lambda m: (m.seq, m.created_at or 0.0)
+        )
+        report.head = head.name
+    elif committed:
+        # Non-stream snapshots under the root (or pre-field members):
+        # newest committed by created_at is still the best recovery
+        # point resolve can offer.
+        report.head = max(
+            committed, key=lambda m: m.created_at or 0.0
+        ).name
+    torn = [m for m in report.members if m.state == "torn"]
+    if torn:
+        report.torn_tail = max(
+            torn, key=lambda m: (m.seq is not None, m.seq or 0)
+        ).name
+    if report.head:
+        report.chain = _chain_of(root, report.head, storage_options)
+        live = set(report.chain)
+        report.superseded = [
+            m.name for m in committed if m.name not in live
+        ]
+    return report
+
+
+def _chain_of(
+    root: str,
+    head_name: str,
+    storage_options: Optional[Dict[str, Any]] = None,
+) -> List[str]:
+    """The member names the head's blob references actually span (head
+    first) — the base_roots recorded at take time, resolved back to
+    member names. Because writers collapse chained references, this IS
+    the complete keep-alive set for the head; no transitive walk is
+    needed (retention still walks transitively as defense in depth)."""
+    from .inspect import load_snapshot_metadata
+
+    head_path = f"{root.rstrip('/')}/{head_name}"
+    try:
+        md = load_snapshot_metadata(head_path, storage_options)
+    except Exception:
+        return [head_name]
+    out = [head_name]
+    for r in md.base_roots or []:
+        # Base roots are relative to the member ("../base-000000").
+        name = posixpath.normpath(posixpath.join(head_name, r))
+        if "/" not in name and name not in out and name != head_name:
+            out.append(name)
+    return out
+
+
+# --------------------------------------------------------------- the stream
+
+
+class DeltaStream:
+    """A live continuous-checkpointing session. Construct via
+    :meth:`tpusnap.Snapshot.stream`. Thread-safe; one capture in flight
+    at a time. See the module docstring for semantics."""
+
+    def __init__(
+        self,
+        root: str,
+        app_state,
+        cadence_s: Optional[float] = None,
+        replicated: Optional[List[str]] = None,
+        storage_options: Optional[Dict[str, Any]] = None,
+        comm: Optional[Communicator] = None,
+        max_chain: Optional[int] = None,
+    ) -> None:
+        comm = get_communicator(comm)
+        if comm.world_size > 1:
+            raise NotImplementedError(
+                "Snapshot.stream is single-process for now: multi-rank "
+                "micro-commit cadence agreement and background state "
+                "capture need their own coordination protocol. Use "
+                "take/async_take with incremental_from for multi-host "
+                "delta checkpointing."
+            )
+        self.root = root
+        if cadence_s is not None:
+            cadence_s = float(cadence_s)
+            if cadence_s <= 0:
+                raise ValueError(
+                    f"cadence_s must be > 0, got {cadence_s!r} (the "
+                    "TPUSNAP_DELTA_CADENCE_S default applies when omitted)"
+                )
+            # Same floor as the knob: a micro-commit is a real
+            # two-phase-committed take.
+            self.cadence_s = max(0.1, cadence_s)
+        else:
+            self.cadence_s = get_delta_cadence_s()
+        self.max_chain = int(max_chain or get_delta_max_chain())
+        self.stream_id = uuid.uuid4().hex[:16]
+        self._app_state = app_state
+        self._replicated = replicated
+        self._storage_options = storage_options
+        self._comm = comm
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._closed = False
+        self._seq = 0
+        self._head: Optional[str] = None  # member NAME
+        self._chain: List[str] = []  # oldest first, head last
+        self._step_gated = False  # a mark_step caller exists
+        self._commit_due = False  # cadence elapsed, capture wanted
+        self._capture_busy = False  # a capture/commit is in flight
+        self._last_commit_mono: float = 0.0
+        self._last_error: Optional[BaseException] = None
+        # A staged-but-not-finalized capture handed off by mark_step:
+        # the worker waits out its background commit drain so the
+        # training thread never blocks past the staging window.
+        self._pending_finalize: Optional[Dict[str, Any]] = None
+        self._observability_stopped = False
+        self.stats: Dict[str, Any] = {
+            "commits": 0,
+            "bytes_written_total": 0,
+            "last_commit_bytes": 0,
+            "last_commit_wall_s": None,
+            "max_commit_interval_s": None,
+            "compactions": 0,
+            "steps_marked": 0,
+        }
+
+        # Refuse a root that already holds stream members: a fresh
+        # base-000000 under committed deltas that reference the OLD
+        # base would silently change the bytes their external
+        # references resolve to. Recovery is explicit — restore
+        # resolve_chain(root).head, then stream to a fresh root.
+        # (Backends that cannot list skip the guard.)
+        existing = resolve_chain(root, storage_options)
+        if existing.members:
+            raise ValueError(
+                f"{root!r} already holds delta-stream member(s) "
+                f"({', '.join(m.name for m in existing.members[:4])}"
+                f"{', ...' if len(existing.members) > 4 else ''}). "
+                "Resuming a stream in place is not supported: restore "
+                f"the recovery head ({existing.head!r}) into your app "
+                "state, then open the stream on a FRESH root (or gc the "
+                "old members first)."
+            )
+
+        # The base: a full, committed snapshot with per-tile dedup
+        # hashes recorded, so the very first increment already skips at
+        # tile grain. Synchronous — the stream is not armed until a
+        # recovery point exists.
+        flight.record(
+            "delta", op="stream_start", stream=self.stream_id,
+            cadence_s=self.cadence_s,
+        )
+        self._commit(kind="base")
+        try:
+            from . import slo as _slo
+
+            _slo.tracker().note_stream(self.cadence_s)
+        except Exception:
+            logger.debug("slo note_stream failed", exc_info=True)
+
+        self._worker = threading.Thread(
+            target=self._run, name="tpusnap-delta", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------- public
+
+    @property
+    def head(self) -> Optional[str]:
+        """Path of the last committed member — the recovery point."""
+        with self._lock:
+            return self._member_path(self._head) if self._head else None
+
+    @property
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def chain(self) -> List[str]:
+        """Committed member names, oldest first."""
+        with self._lock:
+            return list(self._chain)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def mark_step(self, bytes_changed: Optional[int] = None) -> None:
+        """Declare a training-step boundary (call once per optimizer
+        step from the training thread). Arms step-gated capture: each
+        due micro-commit's CAPTURE (state_dict + dual-hash staging)
+        runs inline HERE, at a boundary, so it can never overlap an
+        in-place mutation; the write + two-phase commit still drain in
+        the background. ``bytes_changed`` (optional) feeds the SLO
+        tracker's exact data-at-risk tier."""
+        if bytes_changed:
+            try:
+                from . import slo as _slo
+
+                _slo.record_step(bytes_changed)
+            except Exception:
+                pass
+        capture = False
+        with self._lock:
+            self._step_gated = True
+            self.stats["steps_marked"] += 1
+            if self._commit_due and not self._capture_busy and not self._closed:
+                self._commit_due = False
+                self._capture_busy = True
+                capture = True
+        if capture:
+            # Capture ONLY on the training thread: async_take returns
+            # at staging-complete (incremental takes stage strictly),
+            # so the state is frozen — and safe to mutate again — the
+            # moment _begin_capture returns. The storage writes and the
+            # two-phase commit drain on the take's background thread;
+            # the WORKER waits them out and finalizes, so mark_step
+            # never blocks on storage or compaction.
+            try:
+                ctx = self._begin_capture("delta")
+            except Exception as e:
+                # A failed capture must not take the TRAINING loop down
+                # — stop the stream; the last committed increment stays
+                # the recovery point and raise_if_failed() surfaces it.
+                self._fail(e, where="micro-commit capture in mark_step")
+                with self._cv:
+                    self._capture_busy = False
+                    self._cv.notify_all()
+                return
+            inline = False
+            with self._cv:
+                if self._closed:
+                    # Teardown race: the worker may already be gone —
+                    # finalize here rather than strand the capture.
+                    inline = True
+                else:
+                    self._pending_finalize = ctx
+                    self._cv.notify_all()
+            if inline:
+                try:
+                    self._finalize_capture(ctx)
+                except Exception:
+                    logger.warning(
+                        "DeltaStream finalize during close failed "
+                        "(the previous head remains the recovery point)",
+                        exc_info=True,
+                    )
+                finally:
+                    with self._cv:
+                        self._capture_busy = False
+                        self._cv.notify_all()
+
+    def commit_now(self):
+        """Force a synchronous micro-commit on the calling thread and
+        return the committed :class:`~tpusnap.Snapshot`. Raises if the
+        stream is closed."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("DeltaStream is closed")
+            while self._capture_busy:
+                self._cv.wait()
+                if self._closed:
+                    raise RuntimeError("DeltaStream is closed")
+            self._capture_busy = True
+            self._commit_due = False
+        try:
+            return self._commit(kind="delta")
+        finally:
+            with self._cv:
+                self._capture_busy = False
+                self._cv.notify_all()
+
+    def close(self, final_commit: bool = True) -> Optional[str]:
+        """Stop the stream. With ``final_commit`` (the default) a last
+        micro-commit captures the state as of close, so nothing since
+        the previous cadence tick is lost. Returns the head path.
+        Idempotent."""
+        with self._cv:
+            already = self._closed
+            if not already:
+                self._closed = True
+                self._cv.notify_all()
+        if already:
+            self._stop_observability()
+            return self._member_path(self._head) if self._head else None
+        from .io_types import close_may_join
+
+        if close_may_join():
+            # Joining is safe only on the explicit-close path: a
+            # GC-finalizer close (the lockwatch-caught deadlock class)
+            # skips the join — the daemon worker observes _closed and
+            # exits on its own.
+            # tpusnap: waive=TPS006 join is gated on close_may_join() above
+            self._worker.join(timeout=60.0)
+        # Drain a capture the worker may have exited without finalizing
+        # (mark_step hand-off racing the shutdown).
+        with self._cv:
+            ctx = self._pending_finalize
+            self._pending_finalize = None
+        if ctx is not None:
+            try:
+                self._finalize_capture(ctx)
+            except Exception:
+                logger.warning(
+                    "DeltaStream finalize during close failed (the "
+                    "previous head remains the recovery point)",
+                    exc_info=True,
+                )
+            finally:
+                with self._cv:
+                    self._capture_busy = False
+                    self._cv.notify_all()
+        if final_commit and self._last_error is None:
+            with self._cv:
+                while self._capture_busy:
+                    self._cv.wait()
+                self._capture_busy = True
+            try:
+                self._commit(kind="delta")
+            except Exception:
+                logger.warning(
+                    "DeltaStream final commit failed (the previous head "
+                    "remains the recovery point)",
+                    exc_info=True,
+                )
+            finally:
+                with self._cv:
+                    self._capture_busy = False
+                    self._cv.notify_all()
+        self._stop_observability()
+        return self._member_path(self._head) if self._head else None
+
+    def __enter__(self) -> "DeltaStream":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # On an exception unwind, skip the final commit: the state may
+        # be mid-step garbage; the last committed increment is the
+        # honest recovery point.
+        self.close(final_commit=exc_type is None)
+
+    def raise_if_failed(self) -> None:
+        """Re-raise the worker's terminal failure, if any (a failed
+        micro-commit stops the stream rather than silently shipping
+        stale recovery points forever)."""
+        with self._lock:
+            err = self._last_error
+        if err is not None:
+            raise RuntimeError(
+                "DeltaStream worker failed; the stream is stopped and the "
+                f"last committed increment is the recovery point: {err!r}"
+            ) from err
+
+    # ------------------------------------------------------------ internals
+
+    def _member_path(self, name: str) -> str:
+        return f"{self.root.rstrip('/')}/{name}"
+
+    def _fail(self, exc: BaseException, where: str) -> None:
+        """Stop the stream on a terminal failure (the last committed
+        increment remains the recovery point); raise_if_failed()
+        surfaces the cause to the caller."""
+        logger.error(
+            "DeltaStream %s failed; stopping the stream (the last "
+            "committed increment remains the recovery point)",
+            where,
+            exc_info=True,
+        )
+        with self._cv:
+            self._last_error = exc
+            self._closed = True
+            self._cv.notify_all()
+        self._stop_observability()
+
+    def _stop_observability(self) -> None:
+        """Idempotent teardown of the stream's observability footprint:
+        the SLO tracker's cadence gauge must never advertise a live
+        stream after the stream stopped — for ANY reason, including a
+        failed micro-commit mid-incident (exactly when a dashboard
+        claiming 'delta stream active' would mislead)."""
+        with self._lock:
+            if self._observability_stopped:
+                return
+            self._observability_stopped = True
+        try:
+            from . import slo as _slo
+
+            _slo.tracker().note_stream(None)
+        except Exception:
+            logger.debug("slo note_stream failed", exc_info=True)
+        flight.record(
+            "delta", op="stream_close", stream=self.stream_id,
+            commits=self.stats["commits"],
+        )
+
+    def _run(self) -> None:
+        """Worker loop: finalize captures handed off by mark_step (wait
+        out their background commit drains), wake at cadence, capture
+        here (free-running) or defer to the next mark_step (step-gated,
+        with a one-cadence grace so a stalled training loop cannot
+        suspend checkpointing forever)."""
+        while True:
+            with self._cv:
+                ctx = self._pending_finalize
+                self._pending_finalize = None
+            if ctx is not None:
+                # A mark_step capture: wait out its background commit
+                # drain + bookkeeping/compaction here, off the training
+                # thread.
+                try:
+                    self._finalize_capture(ctx)
+                except Exception as e:
+                    self._fail(e, where="micro-commit")
+                    return
+                finally:
+                    with self._cv:
+                        self._capture_busy = False
+                        self._cv.notify_all()
+                continue
+            with self._cv:
+                deadline = self._last_commit_mono + self.cadence_s
+                while not self._closed and self._pending_finalize is None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=min(remaining, 0.5))
+                if self._pending_finalize is not None:
+                    continue
+                if self._closed:
+                    return
+                if self._capture_busy:
+                    # A commit_now (or an in-flight mark_step capture)
+                    # owns the slot; check back shortly rather than
+                    # stacking a second commit on top.
+                    self._cv.wait(timeout=0.05)
+                    continue
+                if self._step_gated:
+                    # Hand the capture to the training thread: the next
+                    # mark_step performs it at a step boundary.
+                    self._commit_due = True
+                    grace = time.monotonic() + self.cadence_s
+                    while (
+                        not self._closed
+                        and self._commit_due
+                        and time.monotonic() < grace
+                    ):
+                        self._cv.wait(timeout=0.05)
+                    if self._closed:
+                        return
+                    if not self._commit_due:
+                        # mark_step took it (or a commit_now raced in);
+                        # loop to the top — the hand-off pickup and the
+                        # next interval live there.
+                        continue
+                    # Grace expired: training loop stalled mid-step (or
+                    # stopped calling mark_step) — a bounded RPO beats
+                    # step consistency; fall through to a free-running
+                    # capture.
+                    self._commit_due = False
+                self._capture_busy = True
+            try:
+                self._commit(kind="delta")
+            except Exception as e:
+                self._fail(e, where="micro-commit")
+                return
+            finally:
+                with self._cv:
+                    self._capture_busy = False
+                    self._cv.notify_all()
+
+    def _commit(self, kind: str):
+        """One full micro-commit on THIS thread (capture + commit drain
+        + bookkeeping). commit_now/close/base use it; mark_step splits
+        it into _begin_capture (training thread) + _finalize_capture
+        (worker)."""
+        return self._finalize_capture(self._begin_capture(kind))
+
+    def _begin_capture(self, kind: str) -> Dict[str, Any]:
+        """The capture half: state_dict + strict dual-hash staging.
+        When this returns, the content is FROZEN (incremental takes
+        stage everything before async_take returns) and the caller may
+        mutate state again; the storage writes + two-phase commit drain
+        on the take's own background thread. Caller holds the
+        _capture_busy slot (or is __init__)."""
+        from .snapshot import Snapshot
+
+        t0 = time.monotonic()
+        with self._lock:
+            seq = self._seq if kind == "base" else self._seq + 1
+            prev = self._head
+        name = member_name(seq)
+        path = self._member_path(name)
+        extras = {
+            "delta": {
+                "stream": self.stream_id,
+                "seq": seq,
+                "parent": prev,
+            }
+        }
+        ctx: Dict[str, Any] = {"kind": kind, "t0": t0, "seq": seq,
+                               "name": name}
+        if kind == "base":
+            # Full base, tile-grain dedup hashes recorded everywhere.
+            ctx["snap"] = Snapshot.take(
+                path,
+                self._app_state,
+                replicated=self._replicated,
+                storage_options=self._storage_options,
+                comm=self._comm,
+                _extras=extras,
+                _record_dedup_hashes=True,
+            )
+        else:
+            ctx["pending"] = Snapshot.async_take(
+                path,
+                self._app_state,
+                replicated=self._replicated,
+                storage_options=self._storage_options,
+                comm=self._comm,
+                incremental_from=self._member_path(prev),
+                _extras=extras,
+                _record_dedup_hashes=True,
+            )
+        return ctx
+
+    def _finalize_capture(self, ctx: Dict[str, Any]):
+        """The commit half: wait out the background drain (ONE commit in
+        flight at a time — the capture slot is held until this returns),
+        then head/chain bookkeeping and compaction."""
+        kind, t0, seq = ctx["kind"], ctx["t0"], ctx["seq"]
+        name = ctx["name"]
+        snap = ctx.get("snap")
+        if snap is None:
+            snap = ctx["pending"].wait()
+        wall = time.monotonic() - t0
+        written = 0
+        try:
+            written = delta_payload_bytes(snap.metadata)
+        except Exception:
+            logger.debug("delta payload accounting failed", exc_info=True)
+        telemetry.incr("delta.commits")
+        if written:
+            telemetry.incr("delta.bytes_written", written)
+        with self._lock:
+            interval = (
+                time.monotonic() - self._last_commit_mono
+                if self._last_commit_mono
+                else None
+            )
+            self._last_commit_mono = time.monotonic()
+            self._seq = seq
+            self._head = name
+            self._chain.append(name)
+            st = self.stats
+            st["commits"] += 1
+            st["bytes_written_total"] += written
+            st["last_commit_bytes"] = written
+            st["last_commit_wall_s"] = round(wall, 4)
+            if interval is not None:
+                st["max_commit_interval_s"] = max(
+                    st["max_commit_interval_s"] or 0.0, round(interval, 4)
+                )
+            chain_len = len(self._chain)
+        flight.record(
+            "delta",
+            op="micro_commit" if kind != "base" else "base_commit",
+            stream=self.stream_id,
+            seq=seq,
+            bytes=written,
+            wall_s=round(wall, 4),
+        )
+        if chain_len > self.max_chain:
+            self._compact(snap)
+        return snap
+
+    def _compact(self, head_snap) -> None:
+        """Chain compaction via the existing materialize path: the head
+        becomes self-contained (referenced base blobs copied in,
+        checksum-verified, metadata rewritten atomically — a crash
+        mid-copy leaves the old metadata and the chain intact), then
+        the superseded members are retired. Local-fs roots delete them;
+        other backends leave them for `gc`/bucket lifecycle rules."""
+        t0 = time.monotonic()
+        stats = head_snap.materialize()
+        with self._lock:
+            head = self._head
+            superseded = [m for m in self._chain if m != head]
+            self._chain = [head]
+        telemetry.incr("delta.compactions")
+        flight.record(
+            "delta",
+            op="compact",
+            stream=self.stream_id,
+            head=head,
+            bytes_copied=stats.get("bytes_copied", 0),
+            retired=len(superseded),
+            wall_s=round(time.monotonic() - t0, 4),
+        )
+        self.stats["compactions"] += 1
+        parts = urlsplit(self.root)
+        if parts.scheme not in ("", "file"):
+            logger.info(
+                "Delta chain compacted at %r; %d superseded member(s) left "
+                "for bucket lifecycle rules / `tpusnap gc`",
+                self.root,
+                len(superseded),
+            )
+            return
+        import os
+        import shutil
+
+        root = os.path.abspath(parts.path or self.root)
+        for name in superseded:
+            target = os.path.join(root, name)
+            # Metadata first: a retire interrupted mid-delete leaves a
+            # directory that can never be mistaken for a committed
+            # snapshot (resolve_chain reports it as debris; the
+            # crash-matrix covers this window).
+            try:
+                meta = os.path.join(target, ".snapshot_metadata")
+                if os.path.exists(meta):
+                    os.unlink(meta)
+                shutil.rmtree(target, ignore_errors=True)
+            except OSError:
+                logger.warning(
+                    "Failed to retire superseded member %r (reclaim via "
+                    "`tpusnap gc` later)",
+                    target,
+                    exc_info=True,
+                )

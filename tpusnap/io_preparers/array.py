@@ -199,9 +199,11 @@ class ArrayBufferStager(BufferStager):
                     self.cow_pending = True
                     return mv
                 from .. import _native
+                from ..knobs import get_native_copy_threads
 
                 out = _acquire_clone_buffer(mv.nbytes)
-                _native.memcpy(out, mv)  # checksums already recorded
+                # checksums already recorded
+                _native.memcpy(out, mv, nthreads=get_native_copy_threads())
                 return out
             return mv
         if self.is_async_snapshot and _may_alias_live_memory(self.arr, host):
@@ -226,10 +228,15 @@ class ArrayBufferStager(BufferStager):
                 self.cow_pending = True
                 return mv
             from .. import _native
+            from ..knobs import get_native_copy_threads
 
+            # Internal fan-out of each native pass is divided by the
+            # executor thread count so the TOTAL copy-thread budget
+            # stays constant (the ROADMAP 5 anomaly was this nesting).
+            copy_threads = get_native_copy_threads()
             out = _acquire_clone_buffer(mv.nbytes)
             if want_crc and self.defer_checksums:
-                _native.memcpy(out, mv)
+                _native.memcpy(out, mv, nthreads=copy_threads)
                 return out
             if want_crc:
                 tile_rows, row_nbytes = _tile_geometry(self.entry, mv.nbytes)
@@ -239,11 +246,13 @@ class ArrayBufferStager(BufferStager):
                 if tile_rows:
                     if want_dedup:
                         crcs, xxhs = _native.memcpy_crc_xxh_tiles(
-                            out, mv, tile_rows * row_nbytes
+                            out, mv, tile_rows * row_nbytes,
+                            nthreads=copy_threads,
                         )
                     else:
                         crcs = _native.memcpy_crc_tiles(
-                            out, mv, tile_rows * row_nbytes
+                            out, mv, tile_rows * row_nbytes,
+                            nthreads=copy_threads,
                         )
                         xxhs = None
                     _annotate_checksums(
@@ -267,7 +276,9 @@ class ArrayBufferStager(BufferStager):
                     # fused pass would run single-threaded), then fold
                     # the sub-tile values into the one recorded CRC.
                     sub = 16 << 20
-                    crcs = _native.memcpy_crc_tiles(out, mv, sub)
+                    crcs = _native.memcpy_crc_tiles(
+                        out, mv, sub, nthreads=copy_threads
+                    )
                     combined = _fold_crcs(
                         crcs, _tile_lengths(mv.nbytes, sub, len(crcs))
                     )
@@ -275,7 +286,7 @@ class ArrayBufferStager(BufferStager):
                         self.entry, [combined], 0, row_nbytes
                     )
             else:
-                _native.memcpy(out, mv)
+                _native.memcpy(out, mv, nthreads=copy_threads)
             return out
         if want_crc and not self.defer_checksums:
             _record_checksums(self.entry, mv, self.record_dedup_hashes)
@@ -657,6 +668,7 @@ def _record_checksums_impl(
     entry: TensorEntry, mv: memoryview, record_dedup_hashes: bool
 ) -> None:
     from .. import _native
+    from ..knobs import get_native_copy_threads
 
     tile_rows, row_nbytes = _tile_geometry(entry, mv.nbytes)
     want_dedup = _want_dedup_hashes(record_dedup_hashes, tile_rows, mv.nbytes)
@@ -665,7 +677,14 @@ def _record_checksums_impl(
         if want_dedup:
             # Tile boundaries are uniform except the last; the fused
             # native pass tiles by byte count, which matches exactly.
-            crcs, xxhs = _native.crc_xxh_tiles(mv, tile_rows * row_nbytes)
+            # Internal fan-out divided by the stage-thread count — the
+            # dedup hash pass is the hot pass of every delta-stream
+            # micro-commit and must honor the same total-copy-thread
+            # budget as the clone passes.
+            crcs, xxhs = _native.crc_xxh_tiles(
+                mv, tile_rows * row_nbytes,
+                nthreads=get_native_copy_threads(),
+            )
             _annotate_checksums(
                 entry, crcs, tile_rows, row_nbytes, tile_xxhs=xxhs
             )
@@ -679,7 +698,9 @@ def _record_checksums_impl(
         _annotate_checksums(entry, crcs, tile_rows, row_nbytes)
         return
     if want_dedup:
-        crcs, xxhs = _native.crc_xxh_tiles(mv, mv.nbytes)
+        crcs, xxhs = _native.crc_xxh_tiles(
+            mv, mv.nbytes, nthreads=get_native_copy_threads()
+        )
         _annotate_checksums(entry, crcs, 0, row_nbytes, whole_xxh=xxhs[0])
         return
     _annotate_checksums(entry, [_native.crc32c(mv)], 0, row_nbytes)

@@ -51,6 +51,8 @@ _FLIGHT_RING_ENV_VAR = "TPUSNAP_FLIGHT_RING"
 _FLIGHT_FLUSH_ENV_VAR = "TPUSNAP_FLIGHT_FLUSH_S"
 _SLO_RPO_ENV_VAR = "TPUSNAP_SLO_RPO_S"
 _SLO_RTO_ENV_VAR = "TPUSNAP_SLO_RTO_S"
+_DELTA_CADENCE_ENV_VAR = "TPUSNAP_DELTA_CADENCE_S"
+_DELTA_MAX_CHAIN_ENV_VAR = "TPUSNAP_DELTA_MAX_CHAIN"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -323,13 +325,16 @@ def get_history_max_bytes() -> int:
 
 def get_stage_threads() -> int:
     """Worker threads of the write scheduler's staging executor (the
-    clone / DtoH / serialize pass). Default 1: staging is
-    memory-bandwidth work with the GIL released, and interleaved clone
-    threads were measured SLOWER in aggregate than one (~1 GB/s for 4
-    threads vs ~4 GB/s for one on the dev host — cache-line ping-pong
-    plus context switching). Raise on hosts whose memory system feeds
-    multiple cores (real TPU-VMs: 2-4) after measuring; clamped to
-    [1, 16]."""
+    clone / DtoH / serialize pass). Default 1. The historical anomaly
+    (~1 GB/s aggregate for 4 threads vs ~4 GB/s for one on the dev
+    host) was NESTED parallelism: each executor thread already runs a
+    4-way-internal native copy pass, so 4 executor threads
+    oversubscribed the memory system 16 ways — see
+    :func:`get_native_copy_threads`, which now divides the internal
+    fan-out by this knob so the total copy-thread budget stays
+    constant. Raising this is therefore safe everywhere and shifts
+    parallelism grain (useful when per-request Python overhead, not
+    bandwidth, is the bound); clamped to [1, 16]."""
     return max(1, min(16, _get_int_env(_STAGE_THREADS_ENV_VAR, 1)))
 
 
@@ -459,6 +464,50 @@ def get_slo_rto_threshold_s() -> float:
     ``history.jsonl``; with a threshold set and no estimate available,
     ``slo --check`` exits 3 (no verdict), never a silent pass."""
     return max(0.0, _get_float_env(_SLO_RTO_ENV_VAR, 0.0))
+
+
+def get_delta_cadence_s() -> float:
+    """Default micro-commit cadence of a delta stream
+    (:meth:`tpusnap.Snapshot.stream` / :class:`tpusnap.delta.DeltaStream`)
+    when the call doesn't pass ``cadence_s``: the stream commits one
+    journaled incremental micro-snapshot per interval, so this bounds
+    the stream's recovery-point objective — a crash replays base +
+    committed delta chain and loses at most ~one interval of work.
+    Floor 0.1 s (a micro-commit is a real two-phase-committed take;
+    sub-100ms cadences would spend the whole interval committing)."""
+    return max(0.1, _get_float_env(_DELTA_CADENCE_ENV_VAR, 5.0))
+
+
+def get_delta_max_chain() -> int:
+    """Chain-compaction threshold of a delta stream: once the chain
+    from the base to the head exceeds this many members, the stream
+    materializes the head (the existing ``materialize`` path — copying
+    referenced blobs in, checksum-verified, committed atomically) so it
+    becomes the new self-contained base, and retires the superseded
+    members. Bounds both restore fan-in (how many sibling directories a
+    head's blob references span) and the storage a long-running stream
+    pins. Clamped to [2, 1024]."""
+    return max(2, min(1024, _get_int_env(_DELTA_MAX_CHAIN_ENV_VAR, 8)))
+
+
+def get_native_copy_threads() -> int:
+    """Internal threads of ONE native copy/hash pass (``_native.memcpy``
+    and the fused clone+CRC(+XXH64) tile passes), derived so the TOTAL
+    copy-thread budget stays ~constant: ``stage_threads × this ≈ 4``.
+    The ROADMAP 5 staging anomaly (``TPUSNAP_STAGE_THREADS=4`` measured
+    ~1 GB/s aggregate vs ~4 GB/s for 1 on the dev host) was NESTED
+    parallelism, not NUMA: each staging executor thread already fans
+    out to 4 native memcpy threads, so 4 executor threads ran 16 copy
+    threads on a memory system that saturates around 4 — past
+    saturation, extra copy threads are pure cache-line ping-pong and
+    context switching. Measured on a 24-core host: equal-total-budget
+    splits are equivalent (1×4 ≈ 2×2 ≈ 4×1 ≈ 28 GB/s), confirming the
+    total is what matters. With this divisor, raising
+    ``TPUSNAP_STAGE_THREADS`` only shifts the parallelism grain (and
+    overlaps per-request Python overhead) — it can no longer
+    oversubscribe the memory system, which is why the auto-default of
+    1 executor thread stays safe everywhere."""
+    return max(1, 4 // get_stage_threads())
 
 
 def is_lockcheck_enabled() -> bool:
@@ -673,6 +722,18 @@ def override_slo_thresholds(
             stack.enter_context(_override_env(_SLO_RPO_ENV_VAR, str(rpo_s)))
         if rto_s is not None:
             stack.enter_context(_override_env(_SLO_RTO_ENV_VAR, str(rto_s)))
+        yield
+
+
+@contextlib.contextmanager
+def override_delta_cadence_s(seconds: float) -> Generator[None, None, None]:
+    with _override_env(_DELTA_CADENCE_ENV_VAR, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def override_delta_max_chain(n: int) -> Generator[None, None, None]:
+    with _override_env(_DELTA_MAX_CHAIN_ENV_VAR, str(n)):
         yield
 
 

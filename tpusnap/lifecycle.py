@@ -114,27 +114,36 @@ class TakeJournal:
     started_at: float
     incremental_from: Optional[str] = None
     version: str = ""
+    # Delta-chain membership (tpusnap.delta): the stream id, this
+    # micro-commit's sequence number and its parent member name — what
+    # lets fsck/timeline name the in-flight delta state of a take that
+    # never committed (a committed member carries the same fields in
+    # its metadata ``extras["delta"]`` instead).
+    stream: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "take_id": self.take_id,
-                "world_size": self.world_size,
-                "started_at": self.started_at,
-                "incremental_from": self.incremental_from,
-                "version": self.version,
-            }
-        )
+        d = {
+            "take_id": self.take_id,
+            "world_size": self.world_size,
+            "started_at": self.started_at,
+            "incremental_from": self.incremental_from,
+            "version": self.version,
+        }
+        if self.stream:
+            d["stream"] = self.stream
+        return json.dumps(d)
 
     @classmethod
     def from_json(cls, s: str) -> "TakeJournal":
         d = json.loads(s)
+        stream = d.get("stream")
         return cls(
             take_id=d["take_id"],
             world_size=int(d["world_size"]),
             started_at=float(d.get("started_at", 0.0)),
             incremental_from=d.get("incremental_from"),
             version=d.get("version", ""),
+            stream=stream if isinstance(stream, dict) else None,
         )
 
 
@@ -367,8 +376,14 @@ class JournalingStoragePlugin(StoragePlugin):
     # --- journaling core --------------------------------------------------
 
     def _hash_pair(self, buf) -> Tuple[int, str, str]:
+        from .knobs import get_native_copy_threads
+
         mv = memoryview(buf).cast("B")
-        crcs, xxhs = _native.crc_xxh_tiles(mv, 0)  # one fused pass
+        # One fused pass, honoring the total copy-thread budget (the
+        # journal hash runs concurrently with the staging executor).
+        crcs, xxhs = _native.crc_xxh_tiles(
+            mv, 0, nthreads=get_native_copy_threads()
+        )
         return (
             mv.nbytes,
             f"{_native.checksum_algorithm()}:{crcs[0] & 0xFFFFFFFF:08x}",
@@ -495,6 +510,12 @@ class FsckReport:
     # for this snapshot's own files.
     referenced_files: int = 0
     missing_referenced: List[str] = field(default_factory=list)
+    # Delta-chain membership of this directory, when it is (or was
+    # becoming) a micro-commit of a delta stream: {"stream", "seq",
+    # "parent"} from the committed metadata's extras (committed) or
+    # the take journal (torn) — what makes a torn tail explainable as
+    # "micro-commit N over member X" instead of an anonymous torn take.
+    delta: Optional[Dict[str, Any]] = None
     # The listing this classification was computed from (None when the
     # backend cannot list) — reused by gc so one fsck+gc pays one walk.
     files: Optional[Dict[str, int]] = field(default=None, repr=False)
@@ -530,6 +551,22 @@ class FsckReport:
                 if self.journal is not None
                 else ""
             )
+        if self.delta:
+            seq = self.delta.get("seq")
+            parent = self.delta.get("parent")
+            if self.state == "torn":
+                s += (
+                    f" [torn delta micro-commit seq {seq}"
+                    + (f" over {parent!r}" if parent else "")
+                    + " — recovery lands on the last committed increment;"
+                    " retake/gc like any torn take]"
+                )
+            else:
+                s += (
+                    f" [delta increment seq {seq}"
+                    + (f", parent {parent!r}" if parent else "")
+                    + "]"
+                )
         return s
 
 
@@ -651,6 +688,11 @@ def _fsck_impl(
             report.detail = str(e)
             return report
         report.state = "committed"
+        from .manifest_ops import delta_chain_fields
+
+        delta_fields = delta_chain_fields(report.metadata)
+        if delta_fields is not None:
+            report.delta = dict(delta_fields)
         referenced = _referenced_locations(report.metadata)
         report.referenced_files = len(referenced)
         if report.journal is not None:
@@ -678,6 +720,11 @@ def _fsck_impl(
 
     if journal_file_exists:
         report.state = "torn"
+        if report.journal is not None and report.journal.stream:
+            # A torn delta micro-commit: the journal names the stream,
+            # sequence number and parent — recovery is "restore the
+            # last committed increment", never this directory.
+            report.delta = dict(report.journal.stream)
         if report.journal is not None:
             # Already existence/size-filtered against the listing — what
             # a salvage-retake will actually consider (empty on backends

@@ -98,6 +98,53 @@ def get_available_entries(metadata: SnapshotMetadata, rank: int) -> Manifest:
     return get_manifest_for_rank(metadata, rank)
 
 
+def delta_chain_fields(metadata: SnapshotMetadata):
+    """The validated delta-chain fields of a committed snapshot
+    (``extras["delta"]``: stream id, ``seq``, ``parent`` member name) —
+    None for non-stream snapshots. The one place chain membership is
+    decoded, shared by info/fsck/retention and ``tpusnap.delta``."""
+    d = (getattr(metadata, "extras", None) or {}).get("delta")
+    if isinstance(d, dict) and isinstance(d.get("seq"), int):
+        return d
+    return None
+
+
+def external_reference_depth(manifest: Manifest) -> int:
+    """The maximum number of parent (``..``) hops any blob location in
+    ``manifest`` takes. Incremental writers collapse chained references
+    to the member physically holding the bytes, so for a well-formed
+    delta-chain member this is ≤ 1 REGARDLESS of chain depth — the
+    invariant that keeps head lookups flat (restore/read_object resolve
+    every location in one hop, never chasing intermediates). Exposed so
+    tests and tooling can assert it instead of assuming it."""
+    from .manifest import (
+        ChunkedTensorEntry,
+        ObjectEntry,
+        ShardedEntry,
+        TensorEntry,
+    )
+
+    def tensors(entry: Entry):
+        if isinstance(entry, (TensorEntry, ObjectEntry)):
+            yield entry
+        elif isinstance(entry, ChunkedTensorEntry):
+            for c in entry.chunks:
+                yield c.tensor
+        elif isinstance(entry, ShardedEntry):
+            for s in entry.shards:
+                yield s.tensor
+
+    depth = 0
+    for entry in manifest.values():
+        for t in tensors(entry):
+            segs = t.location.split("/")
+            i = 0
+            while i < len(segs) and segs[i] == "..":
+                i += 1
+            depth = max(depth, i)
+    return depth
+
+
 def handle_sharded_elasticity(
     local_manifest: Manifest,
     target_flattened: Dict[str, object],

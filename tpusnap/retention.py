@@ -8,6 +8,14 @@ checksum-verified, before anything is deleted), and only then removes
 the rest. A crash at any point leaves every kept snapshot readable:
 materialization commits atomically, and deletion happens last.
 
+Delta-stream roots (tpusnap.delta) are just directories of incremental
+snapshots, so the same pass IS chain compaction: keeping the newest N
+micro-commits materializes any kept head whose chain members are
+doomed, then retires the rest — a kept delta head can never lose a base
+or intermediate increment it references (``_referenced_bases`` walks
+delta links transitively, so even hand-built non-collapsed chains stay
+pinned end to end).
+
 Local filesystems only (deletion needs directory listing/removal, which
 the storage-plugin API deliberately doesn't expose for object stores —
 cloud retention belongs in bucket lifecycle rules, with
@@ -82,8 +90,9 @@ def _list_snapshots(root: str) -> List[str]:
     return [p for _, p in out]
 
 
-def _referenced_bases(snap_path: str) -> List[str]:
-    """Absolute paths of base snapshots ``snap_path`` references."""
+def _direct_bases(snap_path: str) -> List[str]:
+    """Absolute paths of base snapshots ``snap_path`` DIRECTLY
+    references (has a ``../`` blob location into)."""
     from .inspect import base_root_of_location
 
     md = load_snapshot_metadata(snap_path)
@@ -93,6 +102,32 @@ def _referenced_bases(snap_path: str) -> List[str]:
             base = base_root_of_location(blob.location, md.base_roots)
             bases.add(os.path.abspath(os.path.join(snap_path, base)))
     return sorted(bases)
+
+
+def _referenced_bases(snap_path: str) -> List[str]:
+    """Every base snapshot ``snap_path`` depends on, TRANSITIVELY: a
+    kept delta head must pin its whole chain. Incremental writers
+    collapse chained references (a head's direct refs name every member
+    physically holding its bytes), so the direct set is normally
+    complete — the transitive walk is defense in depth against
+    hand-built or pre-collapse chains, where deleting a base-of-a-base
+    would break a kept snapshot retention itself never inspected.
+    Cycle-safe; unreadable bases end the walk on that branch (they are
+    already broken — materialization of the keeper will surface it)."""
+    out: List[str] = []
+    seen = {os.path.abspath(snap_path)}
+    frontier = _direct_bases(snap_path)
+    while frontier:
+        base = frontier.pop()
+        if base in seen:
+            continue
+        seen.add(base)
+        out.append(base)
+        try:
+            frontier.extend(_direct_bases(base))
+        except Exception:
+            continue
+    return sorted(out)
 
 
 def apply_retention(

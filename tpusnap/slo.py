@@ -286,6 +286,12 @@ class SLOTracker:
         self._rto_key: Optional[tuple] = None
         # Edge-triggered breach episodes.
         self._breached: Dict[str, bool] = {"rpo": False, "rto": False}
+        # Active delta stream's cadence (tpusnap.delta): micro-commits
+        # anchor the RPO like any commit; the cadence published here is
+        # the CONFIGURED bound a healthy stream keeps rpo_s under, so
+        # readers can tell "seconds-scale by design" from "minutes-scale
+        # between takes". None = no stream active.
+        self._stream_cadence_s: Optional[float] = None
         # Sidecar write throttle (monotonic) + write serialization: the
         # pump's tick hook and a commit thread's forced publish share
         # one per-pid temp filename — unserialized, the second open
@@ -344,6 +350,16 @@ class SLOTracker:
             # roughly these bytes — price them now so the pre-crash
             # gauge is live (the crash-matrix acceptance reads it).
             self.refresh_rto()
+
+    def note_stream(self, cadence_s: Optional[float]) -> None:
+        """A delta stream opened (``cadence_s`` set) or closed (None):
+        the configured micro-commit cadence rides the published state so
+        dashboards can grade ``rpo_s`` against the stream's own bound
+        rather than a fleet-wide threshold."""
+        with self._lock:
+            self._stream_cadence_s = (
+                float(cadence_s) if cadence_s else None
+            )
 
     def note_take_aborted(self) -> None:
         """Abort-path bookkeeping (the take's ``on_failure``): release
@@ -581,6 +597,7 @@ class SLOTracker:
                 "estimated_rto_s": rto.seconds if rto.ok else None,
                 "rto_read_gbps": rto.read_gbps if rto.ok else None,
                 "rto_n_baseline": rto.n_baseline,
+                "stream_cadence_s": self._stream_cadence_s,
                 "thresholds": {
                     "rpo_s": rpo_thresh or None,
                     "rto_s": rto_thresh or None,
@@ -902,6 +919,10 @@ def evaluate_records(
             "record_age_s": round(max(now - (rec.get("ts") or now), 0.0), 2),
             "committed": rec.get("last_commit_ts") is not None,
             "fleet": fleet or None,
+            # A live delta stream's configured micro-commit cadence
+            # (tpusnap.delta) — the bound a healthy stream keeps
+            # since_commit under; None when no stream was active.
+            "stream_cadence_s": rec.get("stream_cadence_s"),
         }
         row["breach_rpo"] = bool(
             rpo_threshold_s and since_commit > rpo_threshold_s

@@ -231,6 +231,8 @@ class Snapshot:
         per_key_barrier: bool = False,
         incremental_from: Optional[str] = None,
         _custom_array_prepare_func: Optional[Any] = None,
+        _extras: Optional[Dict[str, Any]] = None,
+        _record_dedup_hashes: bool = False,
     ) -> "Snapshot":
         """``_custom_array_prepare_func(logical_path, arr, tracing)``
         transforms dense, chunked and sharded arrays at save time
@@ -298,6 +300,8 @@ class Snapshot:
                 array_prepare_func=_custom_array_prepare_func,
                 incremental_from=incremental_from,
                 abort_ctx=abort_ctx,
+                extras=_extras,
+                force_dedup_hashes=_record_dedup_hashes,
             )
             drain_start = tele.now()
             pending_io_work.sync_complete(event_loop)
@@ -328,15 +332,40 @@ class Snapshot:
             # TakeAbortedError within seconds instead of burning the
             # full barrier timeout on a failed rank.
             comm.barrier()
-            if comm.rank == 0:
+            # Barrier passed ⟹ every rank published: EVERY rank patches
+            # its local manifest copy (late checksums) and folds the
+            # telemetry rollup. Rank 0's patch is load-bearing (it
+            # writes the file); a non-leader patch failure falls back
+            # to the lazy committed-file read (ADVICE r5 #4).
+            meta_cached = True
+            try:
                 if late_checksums is not None:
                     late_checksums.apply(metadata.manifest)
-                # Barrier passed ⟹ every rank published its telemetry
-                # summary: fold the cross-rank rollup into the extras.
-                tele_commit.apply(metadata)
+                if not tele_commit.apply(metadata):
+                    # Non-leader KV read came back incomplete: its copy
+                    # would diverge from the committed rollup — drop the
+                    # cache, keep the take.
+                    meta_cached = False
+            except Exception:
+                if comm.rank == 0:
+                    raise
+                logger.warning(
+                    "Non-leader late-checksum patch failed; falling back "
+                    "to reading committed metadata (non-fatal)",
+                    exc_info=True,
+                )
+                meta_cached = False
+            if comm.rank == 0:
                 abort_ctx.mark_commit_started()
                 _write_metadata(storage, metadata, event_loop)
+            # The second commit barrier doubles as the cleanup gate:
+            # every rank passing it has read the take-scoped KV blobs,
+            # so rank 0 can delete them after it.
             comm.barrier()
+            if comm.rank == 0:
+                if late_checksums is not None:
+                    late_checksums.cleanup()
+                tele_commit.cleanup()
             # Commit is definitive: mark the take completed (end_take
             # publishes only completed takes to the cross-run history),
             # anchor the SLO tracker (RPO clock restarts, data-at-risk
@@ -384,12 +413,14 @@ class Snapshot:
             abort_ctx.disarm()
             event_loop.close()
         snapshot = cls(path, storage_options, comm)
-        if comm.rank == 0:
+        if meta_cached:
+            # Every rank's copy is fully patched (late checksums + the
+            # telemetry rollup applied locally from the KV blobs), so
+            # every rank caches it — no per-rank metadata GET against
+            # cloud storage on first access. The rare non-leader patch
+            # failure leaves the handle uncached; its first metadata
+            # access reads the committed file rank 0 wrote.
             snapshot._metadata = metadata
-        # else: the non-leader's in-memory copy is missing rank 0's
-        # leader-only mutations (late checksums, the telemetry rollup
-        # extras) — the first metadata access reads the committed file,
-        # which rank 0 wrote fully patched.
         return snapshot
 
     @classmethod
@@ -403,6 +434,8 @@ class Snapshot:
         per_key_barrier: bool = False,
         incremental_from: Optional[str] = None,
         _custom_array_prepare_func: Optional[Any] = None,
+        _extras: Optional[Dict[str, Any]] = None,
+        _record_dedup_hashes: bool = False,
     ) -> "PendingSnapshot":
         comm = get_communicator(comm)
         event_loop = asyncio.new_event_loop()
@@ -429,6 +462,8 @@ class Snapshot:
                 array_prepare_func=_custom_array_prepare_func,
                 incremental_from=incremental_from,
                 abort_ctx=abort_ctx,
+                extras=_extras,
+                force_dedup_hashes=_record_dedup_hashes,
             )
             # Control returns to training here: the blocked window is
             # over — the first staging window is staged (ALL staging,
@@ -455,6 +490,43 @@ class Snapshot:
             abort_ctx.disarm()
             event_loop.close()
             raise
+
+    # ---------------------------------------------------------------- stream
+
+    @classmethod
+    def stream(
+        cls,
+        root: str,
+        app_state: AppState,
+        cadence_s: Optional[float] = None,
+        replicated: Optional[List[str]] = None,
+        storage_options: Optional[Dict[str, Any]] = None,
+        comm: Optional[Communicator] = None,
+        max_chain: Optional[int] = None,
+    ) -> "DeltaStream":
+        """Continuous delta checkpointing: open a :class:`~tpusnap.delta.
+        DeltaStream` under ``root`` — a full base snapshot now, then one
+        journaled incremental micro-commit per ``cadence_s`` (default
+        ``TPUSNAP_DELTA_CADENCE_S``) shipping only tiles/blobs whose
+        fresh CRC32C+XXH64 pair differs from the last committed
+        increment. A crash at any instant recovers, via base + committed
+        delta chain, to a state no older than ~one cadence interval
+        (``tpusnap.delta.resolve_chain(root).head`` names the recovery
+        head; ``Snapshot(head).restore`` replays it transparently).
+        ``close()`` the stream (or use it as a context manager) to stop.
+        See :mod:`tpusnap.delta` for the step-consistency contract
+        (``mark_step``/``commit_now``) and chain compaction."""
+        from .delta import DeltaStream
+
+        return DeltaStream(
+            root,
+            app_state,
+            cadence_s=cadence_s,
+            replicated=replicated,
+            storage_options=storage_options,
+            comm=comm,
+            max_chain=max_chain,
+        )
 
     # --------------------------------------------------------------- restore
 
@@ -878,6 +950,8 @@ def _take_impl(
     array_prepare_func: Optional[Any] = None,
     incremental_from: Optional[str] = None,
     abort_ctx: Optional["_TakeAbortContext"] = None,
+    extras: Optional[Dict[str, Any]] = None,
+    force_dedup_hashes: bool = False,
 ):
     """Core take flow. Exactly TWO all-gathers in the default
     multi-process path (the reference issues ~6 collectives,
@@ -1107,6 +1181,10 @@ def _take_impl(
                     started_at=_time.time(),
                     incremental_from=incremental_from,
                     version=__version__,
+                    # Delta-chain membership rides the journal so a
+                    # SIGKILLed micro-commit stays explainable as
+                    # "seq N over member X", not an anonymous torn take.
+                    stream=(extras or {}).get("delta"),
                 ),
             )
         # EVERY rank eagerly creates its record file before any of its
@@ -1209,7 +1287,9 @@ def _take_impl(
     from .knobs import is_dedup_hash_recording_forced
 
     record_dedup_hashes = (
-        incremental_from is not None or is_dedup_hash_recording_forced()
+        incremental_from is not None
+        or force_dedup_hashes
+        or is_dedup_hash_recording_forced()
     )
     for logical_path, leaf in flattened_all.items():
         is_repl = logical_path in replicated_paths
@@ -1402,6 +1482,10 @@ def _take_impl(
             global_manifest, base_root_candidates
         )
         or None,
+        # Caller-provided sidecar data (e.g. a delta stream's chain
+        # fields) — merged under, never over, the commit-time additions
+        # (the telemetry rollup lands on top of this dict).
+        extras=dict(extras) if extras else None,
     )
     mark("metadata")
     tele_commit = _TelemetryCommit(
@@ -1609,17 +1693,19 @@ class _LateChecksums:
       its own entry objects), ``publish`` puts one blob of
       {location: field tuple} under a take-scoped key;
     - after the commit barrier's arrive phase (every rank arrived ⟹
-      every rank published), RANK 0 ``apply``s: ONE ``try_get_dir``
+      every rank published), EVERY rank ``apply``s: ONE ``try_get_dir``
       RPC collects every rank's blob (not world_size serial gets — the
-      O(N²) pattern ``all_gather_object`` was engineered away from),
-      patches the gathered manifest's stale by-value copies by blob
-      location, and DELETES the key prefix (nothing reads it again, so
-      the coordination service does not accumulate one blob per rank
-      per take for the job's lifetime);
-    - non-leader ranks never read the keys at all: their in-memory
-      manifest copies stay stale, so the take hands them a snapshot
-      handle WITHOUT a cached metadata — their first metadata access
-      reads the committed file, which rank 0 wrote fully patched.
+      O(N²) pattern ``all_gather_object`` was engineered away from)
+      and patches that rank's stale by-value manifest copy by blob
+      location. Rank 0's patch is load-bearing (it writes the file);
+      non-leader patches let the take hand every rank a handle with
+      CACHED metadata instead of world_size−1 metadata GETs against
+      cloud storage on first access (ADVICE r5 #4) — a non-leader
+      patch failure just falls back to the lazy file read;
+    - ``cleanup`` (rank 0 only, strictly after the SECOND commit
+      barrier — every rank passed it ⟹ every rank has read the blobs)
+      DELETES the key prefix, so the coordination service does not
+      accumulate one blob per rank per take for the job's lifetime.
 
     ``take_id`` is agreed via the take's existing G1 gather (rank 0's
     value), not a new broadcast. Every rank publishes — possibly an
@@ -1678,8 +1764,10 @@ class _LateChecksums:
             pass
 
     def apply(self, manifest: Manifest) -> None:
-        """Leader-only: patch + clean up. Callers hold proof every rank
-        published (all ranks arrived at the commit barrier)."""
+        """Patch this rank's manifest copy from the published blobs.
+        Callers hold proof every rank published (all ranks arrived at
+        the commit barrier). Read-only on the KV store — see
+        ``cleanup`` for the deletion."""
         if not self.active:
             return
         import pickle
@@ -1720,7 +1808,14 @@ class _LateChecksums:
                     te.dedup_hash = dh
                 if te.tile_dedup_hashes is None:
                     te.tile_dedup_hashes = tdh
-        store.delete_prefix(self._prefix())
+
+    def cleanup(self) -> None:
+        """Leader-only, strictly after the final commit barrier (every
+        rank passed it ⟹ every rank has applied): delete the take-scoped
+        keys so the coordination service does not grow per take."""
+        if not self.active:
+            return
+        _get_kv_store(self.comm).delete_prefix(self._prefix())
 
 
 _NO_LATE_CHECKSUMS = None  # single-process takes thread None through
@@ -1839,8 +1934,21 @@ class _TelemetryCommit:
                     exc_info=True,
                 )
 
-    def apply(self, metadata: SnapshotMetadata) -> None:
-        """Leader-only, after the commit barrier's arrive phase."""
+    def apply(self, metadata: SnapshotMetadata) -> bool:
+        """Every rank, after the commit barrier's arrive phase (all
+        ranks published): fold the cross-rank rollup into THIS rank's
+        metadata copy. Rank 0's fold lands in the committed file (and
+        tolerates a partial KV read — committing SOME rollup beats
+        failing the take); a NON-LEADER whose KV read came back
+        incomplete returns False WITHOUT folding, so the caller drops
+        its cached copy rather than caching a rollup that diverges from
+        the committed file (ADVICE r5 #4). Read-only on the KV store —
+        ``cleanup`` deletes the prefix."""
+        if self.tele is None:
+            # Telemetry-off take: no rank published a summary and the
+            # committed file carries no rollup — nothing to fold, and
+            # the empty KV prefix must not read as a failed patch.
+            return True
         summaries = []
         if self.comm.world_size > 1 and self.take_id is not None:
             import pickle
@@ -1848,24 +1956,41 @@ class _TelemetryCommit:
             try:
                 store = _get_kv_store(self.comm)
                 blobs = store.try_get_dir(self._prefix())
-                for raw in (blobs or {}).values():
+                for _, raw in sorted((blobs or {}).items()):
                     try:
                         summaries.append(pickle.loads(raw))
                     except Exception:
                         pass
-                store.delete_prefix(self._prefix())
             except Exception:
+                blobs = None
                 summaries = []
+            if (
+                self.comm.rank != 0
+                and len(summaries) < self.comm.world_size
+            ):
+                return False
         if not summaries and self._summary is not None:
             summaries = [self._summary]
         try:
             rollup = telemetry.rollup_summaries(summaries)
         except Exception:
             logger.warning("Telemetry rollup failed (non-fatal)", exc_info=True)
-            return
+            return self.comm.rank == 0
         if rollup:
             metadata.extras = dict(metadata.extras or {})
             metadata.extras["telemetry"] = rollup
+        return True
+
+    def cleanup(self) -> None:
+        """Leader-only, strictly after the final commit barrier: every
+        rank has folded its rollup, delete the take-scoped keys."""
+        if self.comm.world_size > 1 and self.take_id is not None:
+            try:
+                _get_kv_store(self.comm).delete_prefix(self._prefix())
+            except Exception:
+                logger.debug(
+                    "telemetry KV cleanup failed (non-fatal)", exc_info=True
+                )
 
     def discard(self) -> None:
         """Abort path: drop this rank's published summary blob."""
@@ -2187,6 +2312,22 @@ class PendingSnapshot(_BackgroundWork):
             # background commit's barrier waits within seconds.
             watchers=[monitor.check] if monitor is not None else None,
         )
+        # The cleanup gate (ADVICE r5 #4): after the commit barrier's
+        # depart, every rank patches its local manifest copy from the
+        # take-scoped KV blobs; this second barrier proves every rank
+        # has READ them before rank 0 deletes the prefix.
+        self._post_barrier = (
+            LinearBarrier(
+                store=_get_kv_store(comm),
+                prefix=barrier_prefix + "-post",
+                rank=comm.rank,
+                world_size=comm.world_size,
+                timeout_sec=self.BARRIER_TIMEOUT_SEC,
+                watchers=[monitor.check] if monitor is not None else None,
+            )
+            if comm.world_size > 1
+            else None
+        )
         # The main thread is done with collectives for this take; free
         # the communicator's wait watcher for any newer take. The
         # background commit keeps abort awareness via the barrier
@@ -2250,7 +2391,8 @@ class PendingSnapshot(_BackgroundWork):
         if self._comm.rank == 0:
             # arrive() returned ⟹ every rank arrived ⟹ every rank
             # published: patch the gathered manifest (one dir-get),
-            # delete the keys, commit.
+            # commit. The keys outlive the commit until the post
+            # barrier proves every rank has read them.
             if self._late_checksums is not None:
                 self._late_checksums.apply(self._metadata.manifest)
             if self._tele_commit is not None:
@@ -2259,6 +2401,41 @@ class PendingSnapshot(_BackgroundWork):
                 self._abort_ctx.mark_commit_started()
             _write_metadata(self._storage, self._metadata, self._event_loop)
         self._barrier.depart()
+        # depart() returned ⟹ the leader observed every arrival ⟹
+        # every rank published: non-leaders patch their local manifest
+        # copies too (one dir-get each), so every rank's handle carries
+        # cached, fully-patched metadata instead of paying a metadata
+        # GET on first access (ADVICE r5 #4). Best-effort — a failed
+        # patch falls back to the lazy committed-file read.
+        meta_cached = True
+        if self._comm.rank != 0:
+            try:
+                if self._late_checksums is not None:
+                    self._late_checksums.apply(self._metadata.manifest)
+                if self._tele_commit is not None and not self._tele_commit.apply(
+                    self._metadata
+                ):
+                    # Incomplete KV read: don't cache a rollup that
+                    # diverges from the committed file.
+                    meta_cached = False
+            except Exception:
+                logger.warning(
+                    "Non-leader late-checksum patch failed; falling back "
+                    "to reading committed metadata (non-fatal)",
+                    exc_info=True,
+                )
+                meta_cached = False
+        if self._post_barrier is not None:
+            # Every rank arriving here has read the take-scoped KV
+            # blobs; rank 0's arrive() returns once all have, gating
+            # the deletes.
+            self._post_barrier.arrive()
+            if self._comm.rank == 0:
+                if self._late_checksums is not None:
+                    self._late_checksums.cleanup()
+                if self._tele_commit is not None:
+                    self._tele_commit.cleanup()
+            self._post_barrier.depart()
         if self._comm.rank == 0:
             # Commit done (see the sync take's identical step): clear
             # the take journal, strictly after the metadata write.
@@ -2310,11 +2487,11 @@ class PendingSnapshot(_BackgroundWork):
 
         _flight_mod.recorder().end_take("committed")
         snapshot = Snapshot(self.path, self._storage_options, self._comm)
-        if self._comm.rank == 0:
+        if meta_cached:
+            # Fully patched on every rank (late checksums + telemetry
+            # rollup applied locally) — cache it; the rare failed
+            # non-leader patch lazily reads the committed file instead.
             snapshot._metadata = self._metadata
-        # else: stale (missing rank 0's late-checksum patches and
-        # telemetry rollup extras) — lazily read the committed,
-        # fully-patched file instead.
         self._snapshot = snapshot
 
     def _on_error(self, exc: BaseException) -> None:
