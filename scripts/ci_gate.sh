@@ -29,7 +29,13 @@
 #      its exit contract: 0 on a committed path, post-mortem section +
 #      exit 4 on a torn one, exit 3 when no flight data exists
 #      (matching the trace/analyze zero-span contract)
-#   8. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
+#   8. write-back tiering smoke — a tiered take against a chaos-wrapped
+#      remote commits locally (fsck: local-committed), a drain is
+#      killed mid-upload (SIGKILL), the resumed `tpusnap drain`
+#      converges to remote-durable skipping journal-proven blobs, and
+#      the `fsck`/`drain` exit contracts hold at each state; hermetic
+#      like the timeline/slo smokes
+#   9. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
 #      and/or `minio` binary is on PATH, run the `cloud_real` pytest
 #      marker against the real server processes (skipped silently
 #      when the binaries are absent)
@@ -51,14 +57,14 @@ cd "$(dirname "$0")/.."
 fail() { echo "ci_gate: FAIL — $1" >&2; exit "$2"; }
 
 # ---- 1. static analysis --------------------------------------------------
-echo "ci_gate: [1/8] lint --check (AST invariants)"
+echo "ci_gate: [1/9] lint --check (AST invariants)"
 env JAX_PLATFORMS=cpu python -m tpusnap lint --check
 rc=$?
 [ "$rc" -eq 0 ] || fail "tpusnap lint --check (rc=$rc)" "$rc"
 
 # ---- 2. tier-1 -----------------------------------------------------------
 if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
-    echo "ci_gate: [2/8] tier-1 tests"
+    echo "ci_gate: [2/9] tier-1 tests"
     rm -f /tmp/_t1.log
     # cloud_real excluded here: on a host with the server binaries the
     # real-backend suite belongs to step 8, not inside the fast tier.
@@ -69,11 +75,11 @@ if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
     echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
     [ "$rc" -eq 0 ] || fail "tier-1 tests (rc=$rc)" "$rc"
 else
-    echo "ci_gate: [2/8] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
+    echo "ci_gate: [2/9] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
 fi
 
 # ---- 3. cross-run history gate ------------------------------------------
-echo "ci_gate: [3/8] history --check (throughput + p99 write latency)"
+echo "ci_gate: [3/9] history --check (throughput + p99 write latency)"
 for kind in take bench; do
     python -m tpusnap history --check --kind "$kind" \
         --metric throughput_gbps --metric storage_write_p99_s --json
@@ -88,7 +94,7 @@ done
 # ---- 4. analyze doctor on the latest snapshot ---------------------------
 SNAP="${1:-${TPUSNAP_CI_SNAPSHOT:-}}"
 if [ -n "$SNAP" ]; then
-    echo "ci_gate: [4/8] analyze --check $SNAP"
+    echo "ci_gate: [4/9] analyze --check $SNAP"
     python -m tpusnap analyze --check --history "$SNAP"
     rc=$?
     case "$rc" in
@@ -97,11 +103,11 @@ if [ -n "$SNAP" ]; then
         *) fail "analyze --check $SNAP (rc=$rc)" "$rc" ;;
     esac
 else
-    echo "ci_gate: [4/8] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
+    echo "ci_gate: [4/9] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
 fi
 
 # ---- 5. checkpoint-SLO gate smoke ---------------------------------------
-echo "ci_gate: [5/8] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
+echo "ci_gate: [5/9] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, subprocess, sys, tempfile, time
 
@@ -158,7 +164,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "slo --check smoke (rc=$rc)" "$rc"
 
 # ---- 6. delta soak smoke -------------------------------------------------
-echo "ci_gate: [6/8] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
+echo "ci_gate: [6/9] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, re, shutil, signal, subprocess, sys, tempfile, time
 
@@ -302,7 +308,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "delta soak smoke (rc=$rc)" "$rc"
 
 # ---- 7. flight-recorder timeline smoke ----------------------------------
-echo "ci_gate: [7/8] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
+echo "ci_gate: [7/9] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, signal, subprocess, sys, tempfile
 
@@ -375,9 +381,99 @@ PYEOF
 rc=$?
 [ "$rc" -eq 0 ] || fail "timeline smoke (rc=$rc)" "$rc"
 
-# ---- 8. optional real-backend cloud suite --------------------------------
+# ---- 8. write-back tiering smoke ----------------------------------------
+echo "ci_gate: [8/9] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, shutil, signal, subprocess, sys, tempfile
+
+work = tempfile.mkdtemp(prefix="tpusnap_ci_tier_")
+# Hermetic observability: tier status + history scoped to the workdir.
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           TPUSNAP_TELEMETRY_DIR=os.path.join(work, "tele"),
+           TPUSNAP_HISTORY="0", TPUSNAP_TIER_DRAIN="0")
+import atexit
+atexit.register(shutil.rmtree, work, True)
+
+def die(msg):
+    print(f"tiering smoke: FAIL - {msg}", file=sys.stderr)
+    sys.exit(1)
+
+def cli(*args, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "tpusnap", *args],
+        capture_output=True, text=True, env=dict(env, **kw), timeout=180,
+    )
+
+cache = os.path.join(work, "cache")
+remote = os.path.join(work, "remote")
+url = f"tier+local={cache}+remote=fs://{remote}/snap"
+local_dir = os.path.join(cache, remote.lstrip("/"), "snap")
+
+# (a) tiered take (chaos-wrapped remote scheme would not matter here:
+# the take never touches the remote) -> fsck committed + local-committed,
+# drain --status exit 2 (tiered, not yet durable).
+take = (
+    "import os, sys; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+    "os.environ['TPUSNAP_DISABLE_BATCHING']='1'\n"
+    "import jax; jax.config.update('jax_platforms','cpu')\n"
+    "import numpy as np\n"
+    "from tpusnap import Snapshot, StateDict\n"
+    "state={f'w{i}': np.random.default_rng(i).standard_normal((128,128)).astype(np.float32) for i in range(6)}\n"
+    "Snapshot.take(sys.argv[1], {'a': StateDict(**state)})\n"
+)
+subprocess.run([sys.executable, "-c", take, url], check=True, env=env, timeout=180)
+r = cli("fsck", local_dir)
+if r.returncode != 0 or "local-committed" not in r.stdout:
+    die(f"post-take fsck: rc={r.returncode}: {r.stdout[-300:]}")
+r = cli("drain", local_dir, "--status")
+if r.returncode != 2:
+    die(f"drain --status pre-drain: expected 2, got {r.returncode}")
+
+# (b) kill the uploader mid-drain (chaos remote SIGKILLs after the 3rd
+# successful upload), then the resumed drain must reach remote-durable
+# re-uploading nothing already journal-proven.
+kill_drain = (
+    "import os, sys; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+    "os.environ['TPUSNAP_FAULT_SPEC']='crash_after_op=write:3'\n"
+    "import jax; jax.config.update('jax_platforms','cpu')\n"
+    "from tpusnap import tiering\n"
+    "spec = tiering.parse_tier_url(sys.argv[1])\n"
+    "tiering.drain_snapshot(sys.argv[1], remote_url='chaos+'+spec.remote_url)\n"
+)
+r = subprocess.run([sys.executable, "-c", kill_drain, url],
+                   capture_output=True, text=True, env=env, timeout=180)
+if r.returncode != -signal.SIGKILL:
+    die(f"kill drain: expected SIGKILL, got {r.returncode}: {r.stdout[-300:]}{r.stderr[-300:]}")
+r = cli("fsck", local_dir)
+if r.returncode != 0 or "local-committed" not in r.stdout:
+    die(f"post-kill fsck must stay local-committed: {r.stdout[-300:]}")
+
+r = cli("drain", url, "--json")
+if r.returncode != 0:
+    die(f"resumed drain: expected 0, got {r.returncode}: {r.stdout[-300:]}{r.stderr[-300:]}")
+rep = json.loads(r.stdout)
+if rep["state"] != "durable" or rep["blobs_skipped"] < 2:
+    die(f"resumed drain did not skip journal-proven blobs: {rep}")
+
+# (c) exit contracts at the durable state + the remote restores.
+r = cli("fsck", local_dir)
+if r.returncode != 0 or "remote-durable" not in r.stdout:
+    die(f"post-drain fsck: {r.stdout[-300:]}")
+r = cli("drain", local_dir, "--status")
+if r.returncode != 0:
+    die(f"drain --status post-drain: expected 0, got {r.returncode}")
+r = cli("fsck", os.path.join(remote, "snap"))
+if r.returncode != 0:
+    die(f"remote fsck: expected 0 (committed), got {r.returncode}: {r.stdout[-300:]}")
+print(f"tiering smoke: OK (take local, SIGKILL mid-drain, resume skipped "
+      f"{rep['blobs_skipped']}/{rep['blobs_skipped']+rep['blobs_uploaded']} blobs, remote-durable)")
+PYEOF
+rc=$?
+[ "$rc" -eq 0 ] || fail "tiering smoke (rc=$rc)" "$rc"
+
+# ---- 9. optional real-backend cloud suite --------------------------------
 if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&1; then
-    echo "ci_gate: [8/8] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
+    echo "ci_gate: [9/9] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m cloud_real \
         -p no:cacheprovider -p no:xdist -p no:randomly
     rc=$?
@@ -387,7 +483,7 @@ if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&
         fail "real-backend cloud suite (rc=$rc)" "$rc"
     fi
 else
-    echo "ci_gate: [8/8] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
+    echo "ci_gate: [9/9] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
 fi
 
 echo "ci_gate: PASS"

@@ -27,8 +27,20 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
                         empty/foreign)
   gc          PATH      reclaim orphan blobs (dry-run by default; --force
                         deletes; --torn additionally discards a torn
-                        take's salvageable blobs). Safe concurrently with
-                        readers: orphans are never referenced
+                        take's salvageable blobs; --evict-local reclaims
+                        a REMOTE-DURABLE tiered snapshot's local payload
+                        blobs past the retention window). Safe
+                        concurrently with readers: orphans are never
+                        referenced
+  drain       PATH      write-back tiering: drain a tiered snapshot's
+                        local tier to its remote (resumes from the
+                        crash-safe upload journal — blobs already proven
+                        remote by CRC32C+XXH64 evidence are skipped;
+                        bases/delta parents drain first). ``--status``
+                        reports durability + upload lag without
+                        draining; ``--timeout`` bounds outage patience
+                        (exit 0 remote-durable / 2 not converged,
+                        resumable / 3 nothing tiered at PATH)
   trace       PATH      render the take's telemetry (per-stage timings,
                         counters, cross-rank rollup, slowest-rank-per-
                         phase straggler attribution) from the traces
@@ -321,19 +333,46 @@ def cmd_info(args) -> int:
                     f"({worst['skew']:.2f}x the p50) — "
                     "`trace` for the full breakdown"
                 )
+    # Write-back tier durability (tpusnap.tiering): first-class state
+    # of a tiered snapshot's local tier, plus the restore-source label
+    # the RTO estimate below is priced against.
+    restore_backend = None
+    try:
+        from .tiering import parse_tier_url, tier_state_of_dir
+        from .tiering import restore_source_label as _rsl
+
+        spec = parse_tier_url(args.path)
+        local_dir = spec.local_dir if spec is not None else args.path
+        tier = tier_state_of_dir(local_dir)
+        if tier:
+            line = f"durability:  {tier['durability']}"
+            if tier["durability"] == "local-committed":
+                line += (
+                    f" — {_fmt_bytes(tier.get('lag_bytes') or 0)} awaiting "
+                    f"drain to {tier.get('remote')}"
+                )
+            elif tier.get("remote"):
+                line += f" at {tier.get('remote')}"
+            print(line)
+            restore_backend = _rsl(args.path)
+    except Exception:
+        pass
     # History-derived estimated restore time (the tpusnap.slo RTO
     # estimator over the rank-0 restore view): "how long until training
     # resumes from THIS snapshot" — best-effort, shown only when ≥3
-    # comparable restore events exist on this host.
+    # comparable restore events exist on this host. Tiered snapshots
+    # are priced against the tier a restore would actually read from.
     try:
         from .inspect import rank_payload_nbytes
         from .slo import estimate_rto
 
-        est = estimate_rto(rank_payload_nbytes(md, 0))
+        est = estimate_rto(rank_payload_nbytes(md, 0), backend=restore_backend)
         if est.ok:
             print(
                 f"est restore: {_fmt_seconds(est.seconds)} "
-                f"({est.reason}; `slo` for live exposure)"
+                f"({est.reason}"
+                + (f", {restore_backend} history" if restore_backend else "")
+                + "; `slo` for live exposure)"
             )
     except Exception:
         pass
@@ -478,6 +517,9 @@ def cmd_fsck(args) -> int:
     if args.verbose:
         for p in report.missing_referenced:
             print(f"MISSING  {p}")
+        for p in report.evicted:
+            print(f"EVICTED  {p}  (remote-durable; restorable from "
+                  f"{report.tier_remote})")
         for p, sz in sorted(report.orphans.items()):
             print(f"ORPHAN   {_fmt_bytes(sz):>10s}  {p}")
     # committed→0; corrupt-metadata→2 (corruption, like verify); torn→4
@@ -492,11 +534,69 @@ def cmd_fsck(args) -> int:
     return 3
 
 
+def cmd_drain(args) -> int:
+    import json as _json
+
+    from .tiering import (
+        drain_snapshot,
+        parse_tier_url,
+        tier_state_of_dir,
+    )
+
+    spec = parse_tier_url(args.path)
+    local_dir = spec.local_dir if spec is not None else args.path
+
+    if args.status:
+        state = tier_state_of_dir(local_dir)
+        if state is None:
+            print(
+                f"error: {local_dir!r} carries no upload journal — not a "
+                "write-back tiered snapshot (or the drain never started)",
+                file=sys.stderr,
+            )
+            return 3
+        if args.json:
+            print(_json.dumps({"path": local_dir, **state}))
+        else:
+            print(f"path:        {local_dir}")
+            print(f"remote:      {state.get('remote')}")
+            print(f"durability:  {state.get('durability')}")
+            print(
+                f"lag:         {_fmt_bytes(state.get('lag_bytes') or 0)} "
+                f"across {state.get('pending_blobs') or 0} blob(s) "
+                f"({state.get('evidenced_blobs') or 0} proven remote)"
+            )
+        return 0 if state.get("durability") == "remote-durable" else 2
+
+    report = drain_snapshot(
+        args.path,
+        remote_url=args.remote,
+        deadline_s=args.timeout,
+    )
+    if args.json:
+        print(_json.dumps(report.to_json()))
+    else:
+        for base in report.bases:
+            print(f"base: {base.summary()}")
+        print(report.summary())
+    # 0 = remote-durable; 2 = did not converge (outage/degraded — retry
+    # later, the journal resumes); 3 = nothing drainable at the path.
+    if report.state == "durable":
+        return 0
+    if report.state == "no-metadata":
+        print(f"error: {report.error}", file=sys.stderr)
+        return 3
+    return 2
+
+
 def cmd_gc(args) -> int:
     from .lifecycle import gc_snapshot
 
     report = gc_snapshot(
-        args.path, dry_run=not args.force, reclaim_torn=args.torn
+        args.path,
+        dry_run=not args.force,
+        reclaim_torn=args.torn,
+        evict_local=args.evict_local,
     )
     would = "" if args.force else "would "
     for p, sz in sorted(report.reclaimed.items()):
@@ -1036,6 +1136,7 @@ def cmd_timeline(args) -> int:
                 {
                     "path": args.path,
                     "state": report.state,
+                    "durability": report.durability,
                     "delta": report.delta,
                     "ranks": sorted(logs),
                     "skew": {str(r): s for r, s in sorted(skew.items())},
@@ -1047,6 +1148,23 @@ def cmd_timeline(args) -> int:
     else:
         print(f"path:   {args.path}")
         print(f"state:  {report.state} (fsck)")
+        if report.durability is not None:
+            # Write-back tiering: a committed-but-local-only snapshot is
+            # one host failure away from losing its only copy — the
+            # post-mortem must say which side of that line it died on.
+            print(
+                f"tier:   {report.durability}"
+                + (
+                    f" — cloud drain to {report.tier_remote} pending "
+                    "(`tpusnap drain` resumes it)"
+                    if report.durability == "local-committed"
+                    else (
+                        f" at {report.tier_remote}"
+                        if report.tier_remote
+                        else ""
+                    )
+                )
+            )
         if report.delta:
             parent = report.delta.get("parent")
             print(
@@ -1130,6 +1248,12 @@ def cmd_watch(args) -> int:
     commit_seen_at = None
     prev_lines = 0
     interactive = sys.stdout.isatty() and not args.once and not args.json
+    # Tier-lag cache: tier_state_of_dir walks the whole payload tree;
+    # recompute only when the upload journal actually changed (evidence
+    # appends / durable marker) instead of per frame.
+    from .io_types import UPLOAD_JOURNAL_PATH
+
+    tier_cache = {"stat": None, "state": None}
     while True:
         records = read_progress_records(root)
         committed = os.path.exists(os.path.join(root, ".snapshot_metadata"))
@@ -1145,6 +1269,28 @@ def cmd_watch(args) -> int:
         frame = render_watch_table(
             records, committed, stall_flag_s=args.stall_flag
         )
+        # Write-back tiering: the drain's exposure line — a committed
+        # take is not cloud-durable until the lag reaches zero.
+        try:
+            from .tiering import tier_state_of_dir
+
+            st = os.stat(os.path.join(root, UPLOAD_JOURNAL_PATH))
+            key = (st.st_mtime_ns, st.st_size)
+            if key != tier_cache["stat"]:
+                tier_cache["stat"] = key
+                tier_cache["state"] = tier_state_of_dir(root)
+            tier = tier_cache["state"]
+        except Exception:
+            tier = None
+        if tier:
+            if tier["durability"] == "remote-durable":
+                frame += "\ntier: remote-durable"
+            else:
+                frame += (
+                    f"\ntier: local-committed — "
+                    f"{_fmt_bytes(tier.get('lag_bytes') or 0)} awaiting "
+                    f"drain to {tier.get('remote')}"
+                )
         if interactive and prev_lines:
             # Refresh in place: move the cursor back over the last frame.
             sys.stdout.write(f"\x1b[{prev_lines}F\x1b[J")
@@ -1303,6 +1449,7 @@ def _fmt_age(s: float) -> str:
 
 def cmd_slo(args) -> int:
     import json as _json
+    import os as _os
 
     from .slo import evaluate_records, read_slo_records, slo_dir
 
@@ -1311,8 +1458,29 @@ def cmd_slo(args) -> int:
     report = evaluate_records(
         records, rpo_threshold_s=args.rpo, rto_threshold_s=args.rto
     )
+    # Write-back tier exposure (tpusnap.tiering): a degraded uploader
+    # means local-committed bytes whose cloud durability is NOT
+    # converging — an SLO risk surfaced (and gated) alongside RPO/RTO.
+    import time as _time
+
+    from .knobs import get_tier_backoff_cap_s
+    from .tiering import read_tier_status
+
+    tier = read_tier_status(
+        _os.path.dirname(directory.rstrip(_os.sep)) if args.dir else None
+    )
+    # A LIVE degraded drain republishes its status at least once per
+    # backoff cycle; a flag older than a few cycles means the uploader
+    # process is gone (SIGKILLed, or the job ended) — surface it as
+    # stale instead of failing the gate forever on a dead breadcrumb.
+    tier_stale = bool(
+        tier
+        and _time.time() - (tier.get("ts") or 0)
+        > 10 * get_tier_backoff_cap_s()
+    )
+    tier_degraded = bool(tier and tier.get("degraded") and not tier_stale)
     if args.json:
-        print(_json.dumps({"dir": directory, **report}))
+        print(_json.dumps({"dir": directory, "tier": tier, **report}))
     else:
         print(f"slo dir:    {directory}")
         th = report["thresholds"]
@@ -1375,7 +1543,33 @@ def cmd_slo(args) -> int:
                 )
             if any(not r.get("committed") for r in report["ranks"]):
                 print("(* = no commit yet; exposure counted from tracker start)")
+        if tier:
+            if tier_degraded:
+                print(
+                    f"tier:       DEGRADED — remote {tier.get('remote')} "
+                    f"unavailable, {_fmt_bytes(tier.get('lag_bytes') or 0)} "
+                    f"local-committed only "
+                    f"({_fmt_age(tier.get('lag_seconds') or 0)} of lag)"
+                )
+            elif tier.get("state") in ("draining", "degraded"):
+                print(
+                    f"tier:       {'STALE — last uploader status ' if tier_stale else ''}"
+                    f"draining — "
+                    f"{_fmt_bytes(tier.get('lag_bytes') or 0)} awaiting "
+                    f"remote durability"
+                    + (
+                        " (uploader gone? `tpusnap drain` resumes it)"
+                        if tier_stale
+                        else ""
+                    )
+                )
         print(f"\n{report['verdict'].upper()}: {report['reason']}")
+    # A live degraded tier is a breach regardless of whether any SLO
+    # rank records exist yet (a drain-only host still has bytes at
+    # risk) — checked BEFORE the no-records leg so the gate cannot
+    # read exit 3 ("insufficient") out of a real exposure.
+    if args.check and tier_degraded:
+        return 2
     # Without records there is nothing to render in any mode (exit 3,
     # like watch/trace). The 2-on-breach / 3-on-no-verdict legs are
     # gate semantics and apply under --check only.
@@ -1645,7 +1839,45 @@ def main(argv=None) -> int:
         "--torn", action="store_true",
         help="also discard a TORN take's blobs (forfeits salvage-resume)",
     )
+    p.add_argument(
+        "--evict-local", action="store_true",
+        help="write-back tiering: also reclaim a REMOTE-DURABLE "
+        "snapshot's local payload blobs (refused before the upload "
+        "journal's durable marker, and within the "
+        "TPUSNAP_TIER_LOCAL_RETENTION_S hot-cache window; metadata and "
+        "the journal stay, reads through the tier URL fall back to the "
+        "remote)",
+    )
     p.set_defaults(fn=cmd_gc)
+
+    p = sub.add_parser(
+        "drain",
+        help="write-back tiering: force-drain a tiered snapshot to its "
+        "remote tier (resumes from the crash-safe upload journal; "
+        "exit 0 remote-durable / 2 did-not-converge / 3 not tiered)",
+    )
+    p.add_argument(
+        "path",
+        help="tier URL (tier+local=...+remote=...://...) or the local "
+        "tier directory (the upload journal names the remote)",
+    )
+    p.add_argument(
+        "--remote", default=None, metavar="URL",
+        help="override the remote tier URL recorded in the journal",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=None, metavar="SECS",
+        help="give up (exit 2, resumable) after this long of sustained "
+        "remote unavailability (default: keep probing until durable)",
+    )
+    p.add_argument(
+        "--status", action="store_true",
+        help="report the per-snapshot tier state without draining",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser(
         "retain",

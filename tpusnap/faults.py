@@ -39,6 +39,7 @@ import os
 import random
 import signal
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
@@ -80,6 +81,13 @@ class FaultPlan:
       every attempt of the kind). The op stays in flight for the whole
       sleep — the deterministic hang the stall watchdog
       (:mod:`tpusnap.progress`) is tested against.
+    - ``outage``: ("write", 0.0, 10.0) → a SUSTAINED unavailability
+      window: every matching op (kind, or ``*`` for all) raises a
+      transient error from ``start`` seconds after this plugin's first
+      op until ``start + duration``. Deterministic in TIME rather than
+      per-op probability — "cloud down for 10 s mid-drain" as one spec
+      token (``outage=write:10``, ``outage=*:5:10``), the failure shape
+      the write-back tier's circuit breaker exists for.
     """
 
     seed: int = 0
@@ -90,6 +98,7 @@ class FaultPlan:
     latency_sec: float = 0.0
     crash_after_op: Optional[Tuple[str, int]] = None
     stall_op: Optional[Tuple[str, int, float]] = None
+    outage: Optional[Tuple[str, float, float]] = None
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
@@ -124,6 +133,20 @@ class FaultPlan:
                     0 if idx == "*" else int(idx),
                     float(secs),
                 )
+            elif key == "outage":
+                # "write:10" → writes down for the first 10 s;
+                # "*:5:10" → ALL ops down from t=5 s to t=15 s
+                # (t anchored at this plugin's first op).
+                parts = value.split(":")
+                if len(parts) == 2:
+                    plan.outage = (parts[0], 0.0, float(parts[1]))
+                elif len(parts) == 3:
+                    plan.outage = (parts[0], float(parts[1]), float(parts[2]))
+                else:
+                    raise ValueError(
+                        f"outage spec {value!r}: expected <kind>:<secs> "
+                        "or <kind>:<start>:<secs>"
+                    )
             else:
                 raise ValueError(f"Unknown fault spec key {key!r} in {spec!r}")
         return plan
@@ -157,6 +180,15 @@ class _FaultState:
     kind_attempts: Dict[str, int] = field(default_factory=dict)
     per_op_attempts: Dict[Tuple[str, str], int] = field(default_factory=dict)
     lock: threading.Lock = field(default_factory=threading.Lock)
+    # Outage-window anchor (monotonic, set at this plugin's first op)
+    # and the edge-trigger flag for its one flight breadcrumb.
+    outage_anchor: Optional[float] = None
+    outage_announced: bool = False
+
+
+# Monotonic seam for the outage window (tests pin it to a fake clock so
+# the window is exact without sleeps).
+_mono = time.monotonic
 
 
 class FaultInjectionStoragePlugin(StoragePlugin):
@@ -270,9 +302,49 @@ class FaultInjectionStoragePlugin(StoragePlugin):
         idx = plan.stall_op[1]
         return plan.stall_op[2] if idx == 0 or n == idx else 0.0
 
+    def _check_outage(self, kind: str, path: str) -> None:
+        """Raise while a planned sustained-outage window covers this op
+        (deterministic in time, anchored at the plugin's first op)."""
+        plan, st = self.plan, self._state
+        if plan.outage is None:
+            return
+        okind, start, duration = plan.outage
+        now = _mono()
+        with st.lock:
+            # Anchor at the plugin's FIRST op of any kind (as the spec
+            # documents) — a kind-filtered anchor would shift the
+            # window by however long the plugin spent listing/reading
+            # before its first matching op.
+            if st.outage_anchor is None:
+                st.outage_anchor = now
+            t = now - st.outage_anchor
+        if okind not in ("*", kind):
+            return
+        with st.lock:
+            in_window = start <= t < start + duration
+            announce = in_window and not st.outage_announced
+            if announce:
+                st.outage_announced = True
+        if not in_window:
+            return
+        telemetry.incr(f"faults.outage.{kind}")
+        if announce:
+            # One flight breadcrumb per window, not one per rejected op.
+            telemetry.event(
+                "outage_injected", kind=okind, start=start, seconds=duration
+            )
+            flight.record(
+                "fault_outage", op=okind, start=start, seconds=duration
+            )
+        raise InjectedFaultError(
+            f"injected outage: {kind}({path!r}) rejected "
+            f"({t - start:.2f}s into a {duration:.2f}s window)"
+        )
+
     async def _pre(self, kind: str, path: str) -> bool:
         """Apply latency + injected stalls; return whether this attempt
         must fail."""
+        self._check_outage(kind, path)
         inject, latency = self._decide(kind, path)
         if latency:
             telemetry.incr("faults.latency_injections")

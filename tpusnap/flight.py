@@ -180,6 +180,22 @@ class FlightRecorder:
         except Exception:
             return False
 
+    def snapshot_events(self) -> list:
+        """The current ring as event dicts in the flushed-line shape
+        (``{"t", "k", "op"?, ...detail}``) — the in-process read API
+        tests and tooling use without round-tripping a sidecar."""
+        with self._lock:
+            events = list(self._ring)
+        out = []
+        for t, kind, op, detail in events:
+            ev: Dict[str, Any] = {"t": round(t, 6), "k": kind}
+            if op is not None:
+                ev["op"] = op
+            if detail:
+                ev.update(detail)
+            out.append(ev)
+        return out
+
     def mark_take_start(self) -> None:
         """Reset the ring for a new take (called from
         ``telemetry.begin_take``, before the first phase event): the

@@ -122,6 +122,12 @@ def event_from_summary(kind: str, summary: Dict[str, Any]) -> Dict[str, Any]:
         "blobs_salvaged": counters.get("salvage.blobs_salvaged", 0),
         "bytes_salvaged": counters.get("salvage.bytes_salvaged", 0),
     }
+    # The storage backend this run read/wrote (innermost plugin class,
+    # tier-aware for restores): the SLO RTO estimator filters its
+    # baseline on it so cloud-tier restores never get priced with
+    # local-disk history.
+    if summary.get("plugin"):
+        ev["plugin"] = summary["plugin"]
     if "scheduler.budget_used_bytes" in gauges:
         ev["budget_high_water_bytes"] = int(gauges["scheduler.budget_used_bytes"])
     if "peak_rss_delta_bytes" in gauges:

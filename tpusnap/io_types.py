@@ -48,6 +48,11 @@ PROGRESS_DIR = SIDECAR_PREFIX + "progress"  # heartbeat records
 TELEMETRY_DIR = SIDECAR_PREFIX + "telemetry"  # per-rank Chrome traces
 PROBE_DIR = SIDECAR_PREFIX + "probe"  # roofline probe streams
 FLIGHT_DIR = SIDECAR_PREFIX + "flight"  # flight-recorder event logs
+# Write-back tiering (tpusnap.tiering): the crash-safe upload journal a
+# tiered take keeps in its LOCAL tier — per-blob CRC32C+XXH64 evidence
+# of what has been proven remote, plus the durability state marker
+# (state "pending" = local-committed, "durable" = remote-durable).
+UPLOAD_JOURNAL_PATH = SIDECAR_PREFIX + "upload_journal"
 
 T = TypeVar("T")
 

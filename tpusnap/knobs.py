@@ -53,6 +53,11 @@ _SLO_RPO_ENV_VAR = "TPUSNAP_SLO_RPO_S"
 _SLO_RTO_ENV_VAR = "TPUSNAP_SLO_RTO_S"
 _DELTA_CADENCE_ENV_VAR = "TPUSNAP_DELTA_CADENCE_S"
 _DELTA_MAX_CHAIN_ENV_VAR = "TPUSNAP_DELTA_MAX_CHAIN"
+_TIER_DRAIN_ENV_VAR = "TPUSNAP_TIER_DRAIN"
+_TIER_OP_DEADLINE_ENV_VAR = "TPUSNAP_TIER_OP_DEADLINE_S"
+_TIER_OUTAGE_THRESHOLD_ENV_VAR = "TPUSNAP_TIER_OUTAGE_THRESHOLD"
+_TIER_BACKOFF_CAP_ENV_VAR = "TPUSNAP_TIER_BACKOFF_CAP_S"
+_TIER_LOCAL_RETENTION_ENV_VAR = "TPUSNAP_TIER_LOCAL_RETENTION_S"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -490,6 +495,54 @@ def get_delta_max_chain() -> int:
     return max(2, min(1024, _get_int_env(_DELTA_MAX_CHAIN_ENV_VAR, 8)))
 
 
+def is_tier_drain_enabled() -> bool:
+    """Background cloud drain of the write-back tier
+    (:mod:`tpusnap.tiering`): on by default — a take to a
+    ``tier+local=...+remote=...`` URL commits to the local tier at disk
+    speed and the uploader thread drains blobs to the remote tier in the
+    background, converging to ``remote-durable``. ``0`` disables the
+    automatic drain: takes stay ``local-committed`` until
+    ``python -m tpusnap drain`` is run (useful for tests and for
+    operators who schedule drains out of band)."""
+    return os.environ.get(_TIER_DRAIN_ENV_VAR, "1") != "0"
+
+
+def get_tier_op_deadline_s() -> float:
+    """Per-op retry deadline (``retry_deadline_sec``) of the write-back
+    uploader's REMOTE plugin: short by design — once a single upload has
+    made no progress for this long, the retry middleware gives up
+    (``retry.exhausted``) and the uploader's own sustained-outage mode
+    (circuit breaker + capped exponential backoff, takes keep committing
+    locally) takes over. The default 600 s payload deadline would park
+    the drain inside one op for 10 minutes before the outage machinery
+    ever saw a failure."""
+    return max(0.05, _get_float_env(_TIER_OP_DEADLINE_ENV_VAR, 60.0))
+
+
+def get_tier_outage_threshold() -> int:
+    """Consecutive failed remote uploads before the uploader's circuit
+    opens: the drain enters DEGRADED mode (edge-triggered
+    ``tier_degraded`` flight event, `tpusnap_tier_degraded` gauge,
+    capped-backoff probing) instead of hammering a down endpoint."""
+    return max(1, _get_int_env(_TIER_OUTAGE_THRESHOLD_ENV_VAR, 3))
+
+
+def get_tier_backoff_cap_s() -> float:
+    """Cap on the uploader's degraded-mode exponential backoff between
+    remote probes during a sustained outage."""
+    return max(0.05, _get_float_env(_TIER_BACKOFF_CAP_ENV_VAR, 30.0))
+
+
+def get_tier_local_retention_s() -> float:
+    """Hot-local-cache retention policy for ``gc --evict-local``: local
+    payload blobs of a ``remote-durable`` snapshot may be reclaimed only
+    once the remote-durable marker is at least this old. ``0`` (the
+    default) lets an explicit eviction reclaim immediately; a fleet that
+    wants the last N minutes of checkpoints restorable at local-disk
+    speed sets this to that window."""
+    return max(0.0, _get_float_env(_TIER_LOCAL_RETENTION_ENV_VAR, 0.0))
+
+
 def get_native_copy_threads() -> int:
     """Internal threads of ONE native copy/hash pass (``_native.memcpy``
     and the fused clone+CRC(+XXH64) tile passes), derived so the TOTAL
@@ -734,6 +787,43 @@ def override_delta_cadence_s(seconds: float) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_delta_max_chain(n: int) -> Generator[None, None, None]:
     with _override_env(_DELTA_MAX_CHAIN_ENV_VAR, str(n)):
+        yield
+
+
+@contextlib.contextmanager
+def override_tier_drain(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(_TIER_DRAIN_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_tier_outage(
+    threshold: Optional[int] = None,
+    backoff_cap_s: Optional[float] = None,
+    op_deadline_s: Optional[float] = None,
+    local_retention_s: Optional[float] = None,
+) -> Generator[None, None, None]:
+    """Override the write-back tier's outage/retention knobs in one
+    scope (None leaves the corresponding env var untouched)."""
+    with contextlib.ExitStack() as stack:
+        if threshold is not None:
+            stack.enter_context(
+                _override_env(_TIER_OUTAGE_THRESHOLD_ENV_VAR, str(threshold))
+            )
+        if backoff_cap_s is not None:
+            stack.enter_context(
+                _override_env(_TIER_BACKOFF_CAP_ENV_VAR, str(backoff_cap_s))
+            )
+        if op_deadline_s is not None:
+            stack.enter_context(
+                _override_env(_TIER_OP_DEADLINE_ENV_VAR, str(op_deadline_s))
+            )
+        if local_retention_s is not None:
+            stack.enter_context(
+                _override_env(
+                    _TIER_LOCAL_RETENTION_ENV_VAR, str(local_retention_s)
+                )
+            )
         yield
 
 

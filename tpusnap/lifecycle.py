@@ -54,6 +54,7 @@ from .io_types import (
     PROGRESS_DIR,
     SIDECAR_PREFIX,
     TELEMETRY_DIR,
+    UPLOAD_JOURNAL_PATH,
     ReadIO,
     StoragePlugin,
     WriteIO,
@@ -92,6 +93,24 @@ _FLIGHT_SIDECAR_PREFIX = FLIGHT_DIR + "/"
 
 def journal_rank_path(rank: int) -> str:
     return f"{JOURNAL_RECORDS_DIR}/rank_{rank}"
+
+
+def dual_hash_evidence(buf) -> Tuple[int, str, str]:
+    """The dual-hash evidence triple of a buffer —
+    ``(nbytes, "<algo>:<8-hex>", "<algo>:<16-hex>")`` from ONE fused
+    CRC32C+XXH64 pass. The one evidence rule shared by incremental
+    dedup, salvage-resume and the write-back upload journal
+    (:mod:`tpusnap.tiering`): a 32-bit CRC alone leaves a ~2^-32
+    silent-collision channel, the 64-bit lane closes it."""
+    from .knobs import get_native_copy_threads
+
+    mv = memoryview(buf).cast("B")
+    crcs, xxhs = _native.crc_xxh_tiles(mv, 0, nthreads=get_native_copy_threads())
+    return (
+        mv.nbytes,
+        f"{_native.checksum_algorithm()}:{crcs[0] & 0xFFFFFFFF:08x}",
+        f"{_native.dedup_hash_algorithm()}:{xxhs[0] & ((1 << 64) - 1):016x}",
+    )
 
 
 def is_journal_path(path: str) -> bool:
@@ -376,19 +395,9 @@ class JournalingStoragePlugin(StoragePlugin):
     # --- journaling core --------------------------------------------------
 
     def _hash_pair(self, buf) -> Tuple[int, str, str]:
-        from .knobs import get_native_copy_threads
-
-        mv = memoryview(buf).cast("B")
         # One fused pass, honoring the total copy-thread budget (the
         # journal hash runs concurrently with the staging executor).
-        crcs, xxhs = _native.crc_xxh_tiles(
-            mv, 0, nthreads=get_native_copy_threads()
-        )
-        return (
-            mv.nbytes,
-            f"{_native.checksum_algorithm()}:{crcs[0] & 0xFFFFFFFF:08x}",
-            f"{_native.dedup_hash_algorithm()}:{xxhs[0] & ((1 << 64) - 1):016x}",
-        )
+        return dual_hash_evidence(buf)
 
     async def _record(self, path: str, triple: Tuple[int, str, str]) -> None:
         self._records[path] = list(triple)
@@ -510,6 +519,17 @@ class FsckReport:
     # for this snapshot's own files.
     referenced_files: int = 0
     missing_referenced: List[str] = field(default_factory=list)
+    # Write-back tiering (tpusnap.tiering): the two-state durability
+    # ladder when this directory is a tiered snapshot's LOCAL tier —
+    # "local-committed" (upload journal present, drain pending) or
+    # "remote-durable" (the durable marker was written after the last
+    # remote blob + remote metadata verify). None = not tiered.
+    durability: Optional[str] = None
+    tier_remote: Optional[str] = None
+    # remote-durable only: referenced blobs absent LOCALLY because gc
+    # evicted them past the durable marker — restorable through the
+    # tier's remote fallback, so they are NOT counted as missing.
+    evicted: List[str] = field(default_factory=list)
     # Delta-chain membership of this directory, when it is (or was
     # becoming) a micro-commit of a delta stream: {"stream", "seq",
     # "parent"} from the committed metadata's extras (committed) or
@@ -541,6 +561,18 @@ class FsckReport:
                     else ", orphan scan unsupported on this backend"
                 )
             )
+            if self.durability is not None:
+                s += f" [{self.durability}"
+                if self.durability == "local-committed" and self.tier_remote:
+                    s += f" — cloud drain to {self.tier_remote} pending"
+                elif self.tier_remote:
+                    s += f" at {self.tier_remote}"
+                if self.evicted:
+                    s += (
+                        f"; {len(self.evicted)} local blob(s) evicted, "
+                        "restorable from the remote tier"
+                    )
+                s += "]"
         elif self.state == "torn":
             s += (
                 f" — take {self.journal.take_id[:8]} world_size="
@@ -585,12 +617,15 @@ def _referenced_locations(metadata: SnapshotMetadata) -> set:
 
 def _is_legit_sidecar(path: str) -> bool:
     """Sidecars a committed snapshot legitimately carries: telemetry
-    traces, the final heartbeat records and the flight-recorder event
-    logs, nothing else. The journal
-    family is NOT legit post-commit (the commit clears it), and
-    ``.tmp.<pid>`` debris anywhere — including a SIGKILLed
+    traces, the final heartbeat records, the flight-recorder event
+    logs, and the write-back upload journal (it IS the post-commit
+    durability state — clearing it would forget what is proven remote).
+    The take-journal family is NOT legit post-commit (the commit clears
+    it), and ``.tmp.<pid>`` debris anywhere — including a SIGKILLed
     journal/telemetry/heartbeat atomic write — is reclaimable, so both
     count as orphans."""
+    if path == UPLOAD_JOURNAL_PATH:
+        return True
     return (
         path.startswith(
             (
@@ -700,10 +735,28 @@ def _fsck_impl(
                 "stale journal present (crash between metadata commit and "
                 "journal clear) — reclaimable via gc"
             )
+        # Write-back tiering: the upload journal carries the durability
+        # ladder of a tiered snapshot's local tier.
+        from .tiering import durability_of_journal, read_upload_journal
+
+        tier_journal = read_upload_journal(storage, event_loop)
+        report.durability = durability_of_journal(tier_journal)
+        if tier_journal is not None:
+            report.tier_remote = tier_journal.get("remote")
         if report.listing_supported:
             report.missing_referenced = sorted(
                 loc for loc in referenced if loc not in files
             )
+            if (
+                report.missing_referenced
+                and report.durability == "remote-durable"
+            ):
+                # Past the durable marker, a locally-absent referenced
+                # blob is an EVICTED hot-cache entry, not data loss: a
+                # restore through the tier URL reads it from the remote
+                # (fsck the remote URL to verify the cloud copy itself).
+                report.evicted = report.missing_referenced
+                report.missing_referenced = []
             if report.missing_referenced:
                 report.detail = (
                     f"{len(report.missing_referenced)} referenced blob(s) "
@@ -766,6 +819,10 @@ def _fsck_impl(
                 _FLIGHT_SIDECAR_PREFIX,
             )
         )
+        # The write-back upload journal is tier bookkeeping, never take
+        # evidence: a tiered take that died before its take journal (or
+        # with TPUSNAP_DISABLE_JOURNAL=1) must not read as foreign.
+        and p != UPLOAD_JOURNAL_PATH
     }
     if meaningful:
         report.state = "foreign"
@@ -785,6 +842,64 @@ def _fsck_impl(
 
 
 # ----------------------------------------------------------------------- gc
+
+
+def _evictable_local_blobs(
+    path: str,
+    fsck: FsckReport,
+    storage: StoragePlugin,
+    event_loop: asyncio.AbstractEventLoop,
+) -> Dict[str, int]:
+    """The referenced local payload blobs ``gc --evict-local`` may
+    reclaim from a tiered snapshot's local tier. Refuses (raises)
+    unless the snapshot is ``remote-durable`` AND the durable marker
+    has aged past the hot-local-cache retention window — the tiering
+    gc safety rule."""
+    import time as _time
+
+    from .knobs import get_tier_local_retention_s
+    from .tiering import read_upload_journal
+
+    if fsck.durability is None:
+        raise RuntimeError(
+            f"{path!r} is not a tiered snapshot (no upload journal); "
+            "--evict-local only applies to write-back tier local dirs"
+        )
+    if fsck.durability != "remote-durable":
+        raise RuntimeError(
+            f"{path!r} is {fsck.durability}: its blobs are NOT yet proven "
+            "remote — refusing to evict the only durable copy (run "
+            "`tpusnap drain` to convergence first)"
+        )
+    journal = read_upload_journal(storage, event_loop) or {}
+    retention = get_tier_local_retention_s()
+    durable_at = journal.get("durable_at")
+    if retention > 0:
+        age = (
+            _time.time() - durable_at
+            if isinstance(durable_at, (int, float))
+            else 0.0
+        )
+        if age < retention:
+            raise RuntimeError(
+                f"{path!r} became remote-durable only {age:.0f}s ago; "
+                f"TPUSNAP_TIER_LOCAL_RETENTION_S={retention:g} keeps the "
+                "hot local cache that long — re-run later or lower the "
+                "retention window"
+            )
+    from .snapshot import SNAPSHOT_METADATA_FNAME
+
+    referenced = (
+        _referenced_locations(fsck.metadata) if fsck.metadata else set()
+    )
+    files = fsck.files or {}
+    return {
+        p: sz
+        for p, sz in sorted(files.items())
+        if p in referenced
+        and p != SNAPSHOT_METADATA_FNAME
+        and not p.startswith(_SIDECAR_PREFIX)
+    }
 
 
 @dataclass
@@ -815,6 +930,7 @@ def gc_snapshot(
     storage_options: Optional[Dict[str, Any]] = None,
     dry_run: bool = True,
     reclaim_torn: bool = False,
+    evict_local: bool = False,
 ) -> GCReport:
     """Reclaim files a reader can never reach.
 
@@ -828,10 +944,30 @@ def gc_snapshot(
       everything including the journal, returning the path to empty.
     - **corrupt-metadata / foreign**: always refused; an operator must
       decide (restore the metadata from a replica, or delete manually).
+    - ``evict_local=True`` additionally reclaims a tiered snapshot's
+      LOCAL payload blobs — permitted only past ``remote-durable``
+      (the one gc safety rule tiering adds: a blob may leave the local
+      tier only once the upload journal's durable marker proves the
+      remote holds the whole snapshot), and only once the marker is
+      older than the ``TPUSNAP_TIER_LOCAL_RETENTION_S`` hot-cache
+      window. Metadata and the upload journal are never evicted, so
+      the directory keeps classifying as remote-durable and reads
+      through the tier URL fall back to the remote.
 
     ``dry_run=True`` (the default) only reports what would be deleted.
-    Exposed as ``python -m tpusnap gc <path> [--force] [--torn]``."""
+    Exposed as ``python -m tpusnap gc <path> [--force] [--torn]
+    [--evict-local]``."""
     from .storage_plugin import url_to_storage_plugin_in_event_loop
+
+    if evict_local:
+        # Eviction deletes from the LOCAL tier only: through a tier URL
+        # the composed plugin would propagate deletes to the remote —
+        # destroying the very durability that licenses the eviction.
+        from .tiering import parse_tier_url
+
+        spec = parse_tier_url(path)
+        if spec is not None:
+            path = spec.local_dir
 
     event_loop = asyncio.new_event_loop()
     try:
@@ -848,6 +984,10 @@ def gc_snapshot(
                 )
             if fsck.state == "committed":
                 targets = dict(fsck.orphans)
+                if evict_local:
+                    targets.update(
+                        _evictable_local_blobs(path, fsck, storage, event_loop)
+                    )
             elif fsck.state == "torn":
                 if not reclaim_torn:
                     raise RuntimeError(

@@ -119,6 +119,7 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
         self._summary_counts: Dict[str, int] = {}
         self._last_gauges: Dict[str, float] = {}
         self._slo_state: Optional[Dict[str, Any]] = None
+        self._tier_state: Optional[Dict[str, Any]] = None
         self._rank: Optional[int] = None
 
     # --- MetricsSink ----------------------------------------------------
@@ -136,6 +137,13 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
         with self._lock:
             self._slo_state = dict(state)
             self._rank = state.get("rank", self._rank or 0)
+            self._rewrite_locked()
+
+    def on_tier_update(self, state: Dict[str, Any]) -> None:
+        # Write-back tier status (tpusnap.tiering): the uploader's
+        # drain thread publishes on every transition/blob completion.
+        with self._lock:
+            self._tier_state = dict(state)
             self._rewrite_locked()
 
     # --- internals ------------------------------------------------------
@@ -237,6 +245,7 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
             for name, v in sorted(counters.items())
             if name.startswith("retry.transient.")
             or name.startswith("retry.fatal.")
+            or name.startswith("retry.exhausted.")
         ]
         metric(
             "tpusnap_retry_total",
@@ -365,6 +374,33 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
                     ({"objective": k}, 1.0 if breach.get(k) else 0.0)
                     for k in ("rpo", "rto")
                 ],
+            )
+        # Write-back tier gauges (tpusnap.tiering): the at-risk window
+        # between local commit and cloud durability, live through
+        # outages (lag rises while degraded, falls as the drain
+        # recovers), plus the circuit-breaker state itself.
+        tier = self._tier_state
+        if tier is not None:
+            metric(
+                "tpusnap_upload_lag_bytes",
+                "gauge",
+                "Local-committed bytes not yet proven remote by the "
+                "write-back uploader's journal.",
+                [({}, float(tier.get("lag_bytes") or 0))],
+            )
+            metric(
+                "tpusnap_upload_lag_seconds",
+                "gauge",
+                "Age of the oldest local commit still awaiting remote "
+                "durability.",
+                [({}, float(tier.get("lag_seconds") or 0.0))],
+            )
+            metric(
+                "tpusnap_tier_degraded",
+                "gauge",
+                "1 while the uploader's outage circuit is open (remote "
+                "unavailable; takes keep committing locally).",
+                [({}, 1.0 if tier.get("degraded") else 0.0)],
             )
         metric(
             "tpusnap_last_summary_timestamp_seconds",

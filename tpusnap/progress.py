@@ -96,6 +96,16 @@ def local_root_of(path: str) -> Optional[str]:
     only; the KV heartbeat covers the rest)."""
     from urllib.parse import urlsplit
 
+    # Write-back tier URLs: the LOCAL tier is where the heartbeat (and
+    # every other) sidecar lives — watch tails it directly.
+    try:
+        from .tiering import parse_tier_url
+
+        spec = parse_tier_url(path)
+        if spec is not None:
+            return spec.local_dir
+    except ValueError:
+        return None
     u = urlsplit(path)
     scheme = u.scheme
     if scheme.startswith("chaos+"):

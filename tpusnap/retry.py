@@ -211,25 +211,53 @@ class RetryingStoragePlugin(StoragePlugin):
         every retried failure whether or not the op eventually
         succeeds — the telemetry trace is how a chaos run proves its
         injected faults actually exercised this path."""
-        if not self._classify(exc) or self._deadline.expired():
+        transient = self._classify(exc)
+        if not transient or self._deadline.expired():
             # Sidecar-namespace ops are expected-miss probes, not
             # payload failures: the journal read at every take start
             # 404s on a fresh path, and a ``retry.fatal.read`` counter
             # for it reads as a payload-blob retry gone fatal in every
             # stage_breakdown (the BENCH_r06 stray). Label them under
             # their own family so the payload counters stay clean.
-            family = (
-                "retry.fatal.sidecar"
-                if path.startswith(SIDECAR_PREFIX)
-                else "retry.fatal"
-            )
-            telemetry.incr(f"{family}.{op}")
-            if family == "retry.fatal":
-                # Sidecar misses stay out of the black box too — a 404'd
-                # journal probe at take start is not forensic signal.
+            sidecar = path.startswith(SIDECAR_PREFIX)
+            if transient and not sidecar:
+                # Retry-budget EXHAUSTION is its own failure mode: the
+                # error was retriable, the backend just never came back
+                # within the progress deadline. One structured flight
+                # breadcrumb + counter NAME the op that gave up — the
+                # give-up instant used to be indistinguishable from a
+                # hard-fatal classification in every post-mortem.
+                telemetry.incr(f"retry.exhausted.{op}")
                 flight.record(
-                    "retry_fatal", op=op, path=path, error=type(exc).__name__
+                    "retry_exhausted",
+                    op=op,
+                    path=path,
+                    attempts=attempt,
+                    deadline_sec=self.policy.deadline_sec,
+                    error=type(exc).__name__,
                 )
+                logger.warning(
+                    "Retry budget exhausted in %s(%r) after %d attempt(s) "
+                    "(no collective progress for %.0fs): %s",
+                    op,
+                    path,
+                    attempt,
+                    self.policy.deadline_sec,
+                    exc,
+                )
+            else:
+                family = "retry.fatal.sidecar" if sidecar else "retry.fatal"
+                telemetry.incr(f"{family}.{op}")
+                if not sidecar:
+                    # Sidecar misses stay out of the black box too — a
+                    # 404'd journal probe at take start is not forensic
+                    # signal.
+                    flight.record(
+                        "retry_fatal",
+                        op=op,
+                        path=path,
+                        error=type(exc).__name__,
+                    )
             raise exc
         telemetry.incr("retry.attempts")
         telemetry.incr(f"retry.transient.{op}.{type(exc).__name__}")

@@ -172,6 +172,7 @@ def estimate_rto(
     window: int = 20,
     min_baseline: int = 3,
     rank: Optional[int] = 0,
+    backend: Optional[str] = None,
 ) -> RTOEstimate:
     """Estimate the wall-clock of restoring ``snapshot_bytes`` from the
     trailing restore history: bytes over the median restore READ
@@ -181,7 +182,16 @@ def estimate_rto(
     = ``kind == "restore"``, matching rank (default 0), positive
     bytes and wall. Cold restores are NOT filtered out: crash recovery
     is a cold process, and an estimator that only saw warm restores
-    would flatter the fleet."""
+    would flatter the fleet.
+
+    ``backend`` (a storage-plugin class label, e.g. ``S3StoragePlugin``)
+    restricts the baseline to restores that read from that backend —
+    the tier-aware leg: a write-back-tiered snapshot whose local cache
+    was evicted restores from the REMOTE tier, and pricing it with
+    local-disk history would understate the RTO by the disk/cloud
+    throughput ratio. Events recorded before the backend label existed
+    carry none and are excluded by the filter (no verdict beats a wrong
+    one)."""
     if events is None:
         events = _load_recent_restore_events()
     cand = [
@@ -189,6 +199,7 @@ def estimate_rto(
         for e in events
         if e.get("kind") == "restore"
         and (rank is None or e.get("rank", 0) == rank)
+        and (backend is None or e.get("plugin") == backend)
         and (e.get("bytes") or 0) > 0
         and (e.get("wall_s") or 0) > 0
     ][-window:]
@@ -196,8 +207,9 @@ def estimate_rto(
         return RTOEstimate(
             ok=False,
             reason=(
-                f"only {len(cand)} comparable restore event(s) in history; "
-                f"need {min_baseline} to estimate RTO"
+                f"only {len(cand)} comparable restore event(s) in history"
+                + (f" for backend {backend}" if backend else "")
+                + f"; need {min_baseline} to estimate RTO"
             ),
             n_baseline=len(cand),
         )
@@ -521,14 +533,30 @@ class SLOTracker:
                 key = (st.st_mtime_ns, st.st_size, nbytes)
             except OSError:
                 key = (0, 0, nbytes)
+            # Tier-aware pricing: for a write-back-tiered snapshot the
+            # estimator must use the history of the tier a restore
+            # would ACTUALLY read from — local while the cache is
+            # intact, remote once any blob was evicted. None for
+            # non-tiered paths (no filter, today's behavior).
+            backend = None
             with self._lock:
+                path = self._commit_path
+            if path:
+                try:
+                    from .tiering import restore_source_label
+
+                    backend = restore_source_label(path)
+                except Exception:
+                    backend = None
+            with self._lock:
+                key = key + (backend,)
                 if key == self._rto_key:
                     return
                 rank = self.rank
             # THIS rank's restore history: a host running ranks 8-15
             # has no rank-0 events, and its recovery restores its own
             # view under the same disk sharing its peers impose.
-            est = estimate_rto(nbytes, rank=rank)
+            est = estimate_rto(nbytes, rank=rank, backend=backend)
             with self._lock:
                 self._rto = est
                 self._rto_key = key

@@ -626,6 +626,15 @@ class Snapshot:
         self, app_state, comm, per_key_barrier, memory_budget, mark
     ) -> None:
         event_loop, storage = self._resources()
+        try:
+            from .storage_plugin import storage_plugin_label
+
+            # Which backend this restore reads from (tier-aware): the
+            # history event's `plugin` field, what the SLO RTO
+            # estimator filters its baseline on.
+            telemetry.current().meta["plugin"] = storage_plugin_label(storage)
+        except Exception:
+            pass
         metadata = self._get_metadata(storage, event_loop)
         if memory_budget is None:
             memory_budget = get_process_memory_budget_bytes(comm)
@@ -1528,6 +1537,23 @@ def _relative_ref_prefix(base_path: str, new_path: str) -> str:
     import os
     import posixpath
     from urllib.parse import urlsplit
+
+    # Write-back tier URLs are not urlsplit-parseable (the scheme embeds
+    # a path); do the relative math on the LOCAL mirror dirs — the
+    # mirror layout guarantees the same relative relationship holds in
+    # the remote tier, so one recorded reference serves both.
+    from .tiering import parse_tier_url
+
+    try:
+        for is_base, url in ((True, base_path), (False, new_path)):
+            spec = parse_tier_url(url)
+            if spec is not None:
+                if is_base:
+                    base_path = spec.local_dir
+                else:
+                    new_path = spec.local_dir
+    except ValueError:
+        pass  # malformed tier URL: fall through to the plain-path error
 
     a, b = urlsplit(base_path), urlsplit(new_path)
     if a.scheme != b.scheme or a.netloc != b.netloc:

@@ -223,6 +223,30 @@ class InstrumentedStoragePlugin(StoragePlugin):
         await self.inner.close()
 
 
+def storage_plugin_label(plugin: StoragePlugin) -> str:
+    """The innermost backend class name of a composed plugin — the same
+    label the I/O histograms key on — used to tag restore history
+    events with the backend they actually read from. A write-back
+    tiered plugin is labeled by the tier a restore WOULD read
+    (:func:`tpusnap.tiering.restore_source_label`): local while the
+    cache is intact, remote once evicted."""
+    from .tiering import TieredStoragePlugin, restore_source_label
+
+    base = plugin
+    while True:
+        if isinstance(base, TieredStoragePlugin):
+            try:
+                label = restore_source_label(base.spec.url)
+            except Exception:
+                label = None
+            return label or type(base).__name__
+        inner = getattr(base, "inner", None)
+        if isinstance(inner, StoragePlugin):
+            base = inner
+            continue
+        return type(base).__name__
+
+
 def url_to_storage_plugin(
     url_path: str, storage_options: Optional[Dict[str, Any]] = None
 ) -> StoragePlugin:
@@ -232,11 +256,28 @@ def url_to_storage_plugin(
         scheme, path = url_path.split("://", 1)
     else:
         scheme, path = "fs", url_path
+
+    # Write-back tiering composes BEFORE the lowercase/chaos handling:
+    # the scheme embeds a case-sensitive local path, and both tiers
+    # compose their own middleware internally (chaos belongs on the
+    # remote sub-scheme: tier+local=...+remote=chaos+s3://...).
+    if scheme.lower().startswith("tier+"):
+        from .tiering import build_tiered_plugin
+
+        return build_tiered_plugin(url_path, storage_options)
     scheme = scheme.lower()
 
     chaos = scheme.startswith(_CHAOS_PREFIX)
     if chaos:
         scheme = scheme[len(_CHAOS_PREFIX) :] or "fs"
+        if scheme.startswith("tier+"):
+            raise RuntimeError(
+                "chaos cannot wrap a whole tier URL — compose it on the "
+                "remote sub-scheme instead "
+                "(tier+local=...+remote=chaos+<scheme>://...), so faults "
+                "hit the cloud tier the drain tolerates, not the local "
+                "commit-of-record"
+            )
 
     # Runtime-registered factories own their composition: what they
     # return is what callers get (tests register exact plugin doubles).

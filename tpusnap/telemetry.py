@@ -116,6 +116,13 @@ class MetricsSink:
         heartbeat cadence while a take runs and at every commit."""
         pass
 
+    def on_tier_update(self, state: Dict[str, Any]) -> None:
+        """Write-back tier status refresh (:mod:`tpusnap.tiering`):
+        uploader state, upload lag bytes/seconds, degraded flag —
+        pushed by the background drain on every state transition and
+        blob completion."""
+        pass
+
 
 _sinks: Tuple[MetricsSink, ...] = ()
 _sinks_lock = threading.Lock()
@@ -186,6 +193,12 @@ def notify_slo_update(state: Dict[str, Any]) -> None:
     :mod:`tpusnap.slo` publisher's sink leg; same swallow/rate-limit
     contract as every other callback)."""
     _notify("on_slo_update", state)
+
+
+def notify_tier_update(state: Dict[str, Any]) -> None:
+    """Fan one write-back tier status refresh out to every registered
+    sink (the :mod:`tpusnap.tiering` uploader's sink leg)."""
+    _notify("on_tier_update", state)
 
 
 # ---------------------------------------------------- global counters
