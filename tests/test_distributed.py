@@ -217,11 +217,15 @@ def _world_async_take_fault(snap_dir):
 
 def _world_async_take_happy(snap_dir):
     """async_take → training mutates state in place → wait(): the snapshot
-    must hold the PRE-mutation values (defensive-clone invariant under real
-    process parallelism — reference tests/test_async_take.py happy path +
-    io_preparers/tensor.py:281-305). A slow storage plugin guarantees the
-    mutation lands while storage I/O is still in flight."""
+    must hold the PRE-mutation values under real process parallelism, in
+    BOTH staging modes (reference tests/test_async_take.py happy path +
+    io_preparers/tensor.py:281-305). Default (COW) mode: live bytes back
+    the in-flight writes, so training mutates after the wait_staged()
+    rendezvous. TPUSNAP_ASYNC_COW=0: the defensive clone froze the
+    content, so training mutates immediately. A slow storage plugin
+    guarantees the mutation lands while storage I/O is still in flight."""
     import asyncio
+    import os
 
     import numpy as np
 
@@ -242,28 +246,38 @@ def _world_async_take_happy(snap_dir):
         root=url.split("://")[-1]
     )
     try:
-        state = StateDict(
-            w=np.full((1024,), float(comm.rank), dtype=np.float32),
-            step=0,
-        )
-        pending = Snapshot.async_take(snap_dir, {"s": state})
-        assert not pending.done()
-        # "Training step": mutate the live arrays while I/O drains.
-        state["w"] += 1000.0
-        state["step"] = 99
-        pending.wait()
+        for leg, cow in (("cow", True), ("clone", False)):
+            os.environ["TPUSNAP_ASYNC_COW"] = "1" if cow else "0"
+            path = f"{snap_dir}_{leg}"
+            state = StateDict(
+                w=np.full((1024,), float(comm.rank), dtype=np.float32),
+                step=0,
+            )
+            pending = Snapshot.async_take(path, {"s": state})
+            assert not pending.done()
+            if cow:
+                # COW-aware rendezvous: safe to mutate only after THIS
+                # RANK's writes drained (the commit barrier may still be
+                # pending — done() can be False while staged() is True).
+                assert pending.wait_staged(timeout=60.0)
+            # "Training step": mutate the live arrays while the commit
+            # (and in clone mode the storage I/O itself) is in flight.
+            state["w"] += 1000.0
+            state["step"] = 99
+            pending.wait()
+
+            target = {
+                "s": StateDict(w=np.zeros(1024, dtype=np.float32), step=-1)
+            }
+            Snapshot(path).restore(target)
+            np.testing.assert_array_equal(
+                np.asarray(target["s"]["w"]),
+                np.full((1024,), float(comm.rank), dtype=np.float32),
+            )
+            assert target["s"]["step"] == 0
     finally:
         sp.url_to_storage_plugin = orig
-
-    target = {
-        "s": StateDict(w=np.zeros(1024, dtype=np.float32), step=-1)
-    }
-    Snapshot(snap_dir).restore(target)
-    np.testing.assert_array_equal(
-        np.asarray(target["s"]["w"]),
-        np.full((1024,), float(comm.rank), dtype=np.float32),
-    )
-    assert target["s"]["step"] == 0
+        os.environ.pop("TPUSNAP_ASYNC_COW", None)
 
 
 def _world_elastic_restore(snap_dir, phase):

@@ -21,6 +21,7 @@ import pytest
 
 from tpusnap import PytreeState, Snapshot, StateDict, verify_snapshot
 from tpusnap.knobs import (
+    override_async_cow,
     override_batching_disabled,
     override_max_chunk_size_bytes,
     override_max_shard_size_bytes,
@@ -592,9 +593,11 @@ def test_materialized_snapshot_reshards_on_restore(tmp_path):
 
 
 def test_async_incremental_mutation_isolation(tmp_path):
-    """Async incremental take with a CHANGED leaf: the dedup miss takes
-    the hash-then-clone branch, and the clone must freeze the content
-    before training mutates it (deduped leaves never clone — no write)."""
+    """Async incremental take with a CHANGED leaf under the DEFAULT
+    (COW) staging mode: live bytes stay aliased until the write drain,
+    so training mutates only after ``wait_staged()`` — which freezes
+    exactly the pre-mutation content (deduped leaves never clone and
+    never write)."""
     base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
     frozen = np.random.default_rng(0).standard_normal((256, 64)).astype(np.float32)
     hot = np.arange(512, dtype=np.float32)
@@ -606,7 +609,11 @@ def test_async_incremental_mutation_isolation(tmp_path):
         pending = Snapshot.async_take(
             inc, {"app": state}, incremental_from=base
         )
-        # Training continues: overwrite both arrays AFTER control returned.
+        # Training continues AFTER the COW-aware rendezvous: under the
+        # default TPUSNAP_ASYNC_COW staging the live bytes back the
+        # in-flight writes, and wait_staged() is the safe-to-mutate
+        # contract.
+        assert pending.wait_staged()
         hot2[:] = -99.0
         frozen_view = state["frozen"]
         frozen_view[:] = -77.0
@@ -615,11 +622,39 @@ def test_async_incremental_mutation_isolation(tmp_path):
     assert snap.verify().clean
     target = {"app": StateDict(frozen=np.zeros_like(frozen), hot=np.zeros(512, np.float32))}
     Snapshot(inc).restore(target)
-    # hot: pre-mutation changed value (clone froze it).
+    # hot: pre-mutation changed value (the drain completed before the
+    # mutation).
     assert np.array_equal(target["app"]["hot"], hot + 1.0)
     # frozen: deduped against the base — the BASE's bytes, untouched by
-    # the post-return mutation of the live array (which aliases `frozen`,
-    # hence the pre-mutation copy).
+    # the post-drain mutation of the live array.
+    assert np.array_equal(target["app"]["frozen"], frozen_orig)
+
+
+def test_async_incremental_mutation_isolation_cow_off(tmp_path):
+    """The TPUSNAP_ASYNC_COW=0 escape hatch restores the defensive-clone
+    contract: mutate IMMEDIATELY after control returns, before any write
+    drains — the clone froze the content, so the take still commits the
+    pre-mutation bytes."""
+    base, inc = str(tmp_path / "s0"), str(tmp_path / "s1")
+    frozen = np.random.default_rng(0).standard_normal((256, 64)).astype(np.float32)
+    hot = np.arange(512, dtype=np.float32)
+    frozen_orig = frozen.copy()
+    with override_batching_disabled(True), override_async_cow(False):
+        Snapshot.take(base, {"app": StateDict(frozen=frozen, hot=hot)})
+        hot2 = hot + 1.0
+        state = StateDict(frozen=frozen, hot=hot2)
+        pending = Snapshot.async_take(
+            inc, {"app": state}, incremental_from=base
+        )
+        # No rendezvous: the clone already froze the content.
+        hot2[:] = -99.0
+        state["frozen"][:] = -77.0
+        snap = pending.wait()
+    assert _blob_files(inc) == ["0/app/hot"]
+    assert snap.verify().clean
+    target = {"app": StateDict(frozen=np.zeros_like(frozen), hot=np.zeros(512, np.float32))}
+    Snapshot(inc).restore(target)
+    assert np.array_equal(target["app"]["hot"], hot + 1.0)
     assert np.array_equal(target["app"]["frozen"], frozen_orig)
 
 

@@ -259,9 +259,14 @@ def test_window_fits_state_keeps_strict_semantics(tmp_path):
     blocked window — the pre-pipeline consistency contract (mutate
     in place right after return) holds exactly, as does window=0."""
     state = _state(n=3)
+    # override_async_cow(False): "mutate right after return" is the
+    # defensive-CLONE contract; the default COW mode's contract is
+    # wait_staged() (covered in the COW section below).
     for window in (1 << 30, 0):
         path = str(tmp_path / f"snap{window}")
-        with override_async_stage_window_bytes(window):
+        with override_async_stage_window_bytes(window), override_async_cow(
+            False
+        ):
             pending = Snapshot.async_take(path, {"m": PytreeState(state)})
             assert pending.staged()  # frozen before control returned
             # "Training step": in-place mutation while I/O drains.
@@ -335,9 +340,13 @@ def test_warm_pool_reuse_across_windows(tmp_path):
     sp.clear()
     state = _state()
     path = str(tmp_path / "snap")
+    # Clone mode: the pool LIFO contract under test only exists when
+    # staging clones (the default COW mode clones nothing).
     with override_batching_disabled(True), override_journal_disabled(
         True
-    ), override_async_stage_window_bytes(2 * 2 * _PER):
+    ), override_async_stage_window_bytes(2 * 2 * _PER), override_async_cow(
+        False
+    ):
         Snapshot.async_take(path, {"m": PytreeState(state)}).wait()
     try:
         # All clones parked back; far fewer distinct buffers than blobs.

@@ -87,28 +87,36 @@ def test_double_release_is_inert():
 
 
 def test_async_take_loop_reuses_buffers(tmp_path):
-    """End to end: the second async take's clones come from the pool."""
+    """End to end: the second async take's clones come from the pool.
+    Clone mode (``TPUSNAP_ASYNC_COW=0``): the default COW staging
+    clones nothing, so there is no pool traffic to test there."""
     import tpusnap._staging_pool as sp
     from tpusnap import PytreeState, Snapshot
+    from tpusnap.knobs import override_async_cow
 
     state = {
         f"w{i}": np.random.default_rng(i).standard_normal(1 << 17).astype(np.float32)
         for i in range(3)
     }  # 512 KiB each — above the pool's reuse floor, below slab batching? (they batch; members release too)
-    Snapshot.async_take(str(tmp_path / "s0"), {"m": PytreeState(state)}).wait()
-    free_after_first = sp.free_bytes()
-    assert free_after_first > 0  # clones returned to the pool
-    from tpusnap import telemetry
+    with override_async_cow(False):
+        Snapshot.async_take(
+            str(tmp_path / "s0"), {"m": PytreeState(state)}
+        ).wait()
+        free_after_first = sp.free_bytes()
+        assert free_after_first > 0  # clones returned to the pool
+        from tpusnap import telemetry
 
-    hits_before = telemetry.counter_value("staging_pool.hits")
-    Snapshot.async_take(str(tmp_path / "s1"), {"m": PytreeState(state)}).wait()
-    # Steady state: the second take's clones come back warm from the
-    # pool. (Exact free_bytes equality is scheduler-timing dependent —
-    # an acquire racing the previous window's release may allocate one
-    # extra buffer — so assert reuse happened and growth stays bounded
-    # by one take's worth, rather than byte-exact stasis.)
-    assert telemetry.counter_value("staging_pool.hits") > hits_before
-    assert sp.free_bytes() <= 2 * free_after_first
+        hits_before = telemetry.counter_value("staging_pool.hits")
+        Snapshot.async_take(
+            str(tmp_path / "s1"), {"m": PytreeState(state)}
+        ).wait()
+        # Steady state: the second take's clones come back warm from the
+        # pool. (Exact free_bytes equality is scheduler-timing dependent —
+        # an acquire racing the previous window's release may allocate one
+        # extra buffer — so assert reuse happened and growth stays bounded
+        # by one take's worth, rather than byte-exact stasis.)
+        assert telemetry.counter_value("staging_pool.hits") > hits_before
+        assert sp.free_bytes() <= 2 * free_after_first
     # Both snapshots independently restore bit-exact.
     for s in ("s0", "s1"):
         tgt = {"m": PytreeState({k: np.zeros_like(v) for k, v in state.items()})}

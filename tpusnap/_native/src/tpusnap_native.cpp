@@ -1254,4 +1254,465 @@ uint32_t ts_crc32c_combine(uint32_t crc1, uint32_t crc2, uint64_t len2) {
   return crc1 ^ crc2;
 }
 
+// ---------------------------------------------------------------------------
+// Dtype-aware fused tile compression.
+//
+// The engine's staging hot path already makes one fused memory pass per
+// tile (clone + CRC32C + XXH64 above). On network-bound destinations
+// (cloud, virtio, the write-back tier's remote drain) the storage pipe —
+// not the host — is the ceiling, so a codec stage rides the same pass:
+// a byte-shuffle filter keyed on dtype element size (bf16/f32/f64
+// exponent bytes group into near-constant planes; fp8/int8 skip the
+// filter) followed by LZ4 block compression, per checksum tile, so the
+// restore path keeps tile-grain random access. The implementation is
+// self-contained (the container ships no lz4/zstd library): a greedy
+// hash-chain LZ4 block encoder and a bounds-checked decoder, both
+// producing/consuming the standard LZ4 block format. Determinism is
+// load-bearing: incremental dedup and salvage-resume compare hashes of
+// the COMPRESSED bytes, so equal input must always yield equal output
+// (fixed table size, greedy matching, no threads inside one tile).
+
+static const size_t kLz4TableBits = 13;
+static const size_t kLz4TableSize = 1u << kLz4TableBits;
+
+static inline uint32_t lz4_read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+static inline uint32_t lz4_hash(uint32_t v) {
+  return (v * 2654435761u) >> (32 - kLz4TableBits);
+}
+
+// Compress src[0..n) into dst[0..cap) (standard LZ4 block format).
+// Returns the compressed size, or 0 when the output would reach ``cap``
+// (caller stores the tile raw). ``table`` must hold kLz4TableSize
+// uint32 slots; it is reset here (one memset per tile, reused across a
+// thread's tiles).
+static size_t lz4_compress_block(const uint8_t* src, size_t n, uint8_t* dst,
+                                 size_t cap, uint32_t* table) {
+  if (n == 0 || cap == 0) return 0;
+  std::memset(table, 0, kLz4TableSize * sizeof(uint32_t));
+  const uint8_t* ip = src;
+  const uint8_t* anchor = src;
+  const uint8_t* const iend = src + n;
+  // Spec: the last match must start >= 12 bytes before the end, and the
+  // last 5 bytes are always literals.
+  const uint8_t* const mflimit = (n > 12) ? iend - 12 : src;
+  const uint8_t* const matchlimit = iend - 5;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + cap;
+
+  while (ip < mflimit) {
+    const uint32_t v = lz4_read32(ip);
+    const uint32_t h = lz4_hash(v);
+    const uint8_t* ref = src + table[h];
+    table[h] = static_cast<uint32_t>(ip - src);
+    if (ref >= ip || static_cast<size_t>(ip - ref) > 65535 ||
+        lz4_read32(ref) != v) {
+      ++ip;
+      continue;
+    }
+    // Extend the match forward.
+    size_t mlen = 4;
+    while (ip + mlen < matchlimit && ip[mlen] == ref[mlen]) ++mlen;
+    const size_t litlen = static_cast<size_t>(ip - anchor);
+    // Worst-case sequence size: token + litlen extras + literals +
+    // offset + matchlen extras.
+    const size_t need = 1 + litlen / 255 + 1 + litlen + 2 + mlen / 255 + 1;
+    if (static_cast<size_t>(oend - op) < need) return 0;
+    uint8_t* token = op++;
+    if (litlen >= 15) {
+      *token = 15 << 4;
+      size_t rest = litlen - 15;
+      while (rest >= 255) {
+        *op++ = 255;
+        rest -= 255;
+      }
+      *op++ = static_cast<uint8_t>(rest);
+    } else {
+      *token = static_cast<uint8_t>(litlen << 4);
+    }
+    std::memcpy(op, anchor, litlen);
+    op += litlen;
+    const size_t offset = static_cast<size_t>(ip - ref);
+    *op++ = static_cast<uint8_t>(offset & 0xff);
+    *op++ = static_cast<uint8_t>(offset >> 8);
+    size_t mcode = mlen - 4;
+    if (mcode >= 15) {
+      *token |= 15;
+      mcode -= 15;
+      while (mcode >= 255) {
+        *op++ = 255;
+        mcode -= 255;
+      }
+      *op++ = static_cast<uint8_t>(mcode);
+    } else {
+      *token |= static_cast<uint8_t>(mcode);
+    }
+    ip += mlen;
+    anchor = ip;
+    if (ip < mflimit) {
+      // Seed the table at the match tail so back-to-back matches chain.
+      table[lz4_hash(lz4_read32(ip - 2))] =
+          static_cast<uint32_t>(ip - 2 - src);
+    }
+  }
+  // Final literals-only sequence.
+  const size_t litlen = static_cast<size_t>(iend - anchor);
+  const size_t need = 1 + litlen / 255 + 1 + litlen;
+  if (static_cast<size_t>(oend - op) < need) return 0;
+  uint8_t* token = op++;
+  if (litlen >= 15) {
+    *token = 15 << 4;
+    size_t rest = litlen - 15;
+    while (rest >= 255) {
+      *op++ = 255;
+      rest -= 255;
+    }
+    *op++ = static_cast<uint8_t>(rest);
+  } else {
+    *token = static_cast<uint8_t>(litlen << 4);
+  }
+  std::memcpy(op, anchor, litlen);
+  op += litlen;
+  return static_cast<size_t>(op - dst);
+}
+
+// Bounds-checked LZ4 block decode. Returns decompressed size or -1 on
+// any malformed input (scrub catches bit-rot by CRC first; this guard
+// is for defense in depth — corrupt input must never write out of
+// bounds or loop forever).
+static int64_t lz4_decompress_block(const uint8_t* src, size_t n,
+                                    uint8_t* dst, size_t cap) {
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + n;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + cap;
+  while (ip < iend) {
+    const uint8_t token = *ip++;
+    size_t litlen = token >> 4;
+    if (litlen == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        litlen += b;
+      } while (b == 255);
+    }
+    if (litlen > static_cast<size_t>(iend - ip) ||
+        litlen > static_cast<size_t>(oend - op))
+      return -1;
+    std::memcpy(op, ip, litlen);
+    op += litlen;
+    ip += litlen;
+    if (ip >= iend) break;  // last sequence carries no match
+    if (iend - ip < 2) return -1;
+    const size_t offset =
+        static_cast<size_t>(ip[0]) | (static_cast<size_t>(ip[1]) << 8);
+    ip += 2;
+    if (offset == 0 || offset > static_cast<size_t>(op - dst)) return -1;
+    size_t mlen = token & 15;
+    if (mlen == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        mlen += b;
+      } while (b == 255);
+    }
+    mlen += 4;
+    if (mlen > static_cast<size_t>(oend - op)) return -1;
+    const uint8_t* match = op - offset;
+    if (offset >= mlen) {
+      std::memcpy(op, match, mlen);
+    } else {
+      // Overlapping copy: forward byte order replicates the window
+      // (RLE-style matches), exactly per the format.
+      for (size_t i = 0; i < mlen; ++i) op[i] = match[i];
+    }
+    op += mlen;
+  }
+  return static_cast<int64_t>(op - dst);
+}
+
+// Byte-shuffle filter: split ``n`` bytes of ``elem``-sized values into
+// ``elem`` byte planes (plane j = bytes j, j+elem, j+2*elem, ...). For
+// float dtypes the exponent/sign bytes of nearby values are near
+// constant, so their plane becomes long runs LZ4 folds away. A non-
+// multiple tail rides raw after the planes.
+static void byte_shuffle(const uint8_t* src, uint8_t* dst, size_t n,
+                         int elem) {
+  if (elem <= 1) {
+    std::memcpy(dst, src, n);
+    return;
+  }
+  const size_t ne = n / static_cast<size_t>(elem);
+  for (int j = 0; j < elem; ++j) {
+    uint8_t* d = dst + static_cast<size_t>(j) * ne;
+    const uint8_t* s = src + j;
+    for (size_t i = 0; i < ne; ++i) d[i] = s[i * elem];
+  }
+  const size_t body = ne * static_cast<size_t>(elem);
+  std::memcpy(dst + body, src + body, n - body);
+}
+
+static void byte_unshuffle(const uint8_t* src, uint8_t* dst, size_t n,
+                           int elem) {
+  if (elem <= 1) {
+    std::memcpy(dst, src, n);
+    return;
+  }
+  const size_t ne = n / static_cast<size_t>(elem);
+  for (int j = 0; j < elem; ++j) {
+    const uint8_t* s = src + static_cast<size_t>(j) * ne;
+    uint8_t* d = dst + j;
+    for (size_t i = 0; i < ne; ++i) d[i * elem] = s[i];
+  }
+  const size_t body = ne * static_cast<size_t>(elem);
+  std::memcpy(dst + body, src + body, n - body);
+}
+
+// Raw single-buffer entry points (unit tests, the Python policy's codec
+// micro-benchmark). ``elem`` <= 1 skips the shuffle filter.
+int64_t ts_lz4_compress(const void* src, size_t n, void* dst, size_t cap,
+                        int elem) {
+  std::vector<uint32_t> table(kLz4TableSize);
+  const uint8_t* in = static_cast<const uint8_t*>(src);
+  std::vector<uint8_t> shuffled;
+  if (elem > 1 && n > 0) {
+    shuffled.resize(n);
+    byte_shuffle(in, shuffled.data(), n, elem);
+    in = shuffled.data();
+  }
+  const size_t got = lz4_compress_block(in, n, static_cast<uint8_t*>(dst),
+                                        cap, table.data());
+  return got == 0 ? -1 : static_cast<int64_t>(got);
+}
+
+int64_t ts_lz4_decompress(const void* src, size_t n, void* dst, size_t cap,
+                          int elem) {
+  if (elem > 1 && cap > 0) {
+    std::vector<uint8_t> shuffled(cap);
+    const int64_t got = lz4_decompress_block(
+        static_cast<const uint8_t*>(src), n, shuffled.data(), cap);
+    if (got < 0) return got;
+    byte_unshuffle(shuffled.data(), static_cast<uint8_t*>(dst),
+                   static_cast<size_t>(got), elem);
+    return got;
+  }
+  return lz4_decompress_block(static_cast<const uint8_t*>(src), n,
+                              static_cast<uint8_t*>(dst), cap);
+}
+
+// Per-tile output slot: worst-case LZ4 expansion plus headroom, rounded
+// so slots stay 64-byte aligned. The Python side sizes the destination
+// buffer with ts_compress_bound (same formula — one definition each
+// side of the FFI, asserted equal by the bindings at load time).
+static size_t lz4_slot_stride(size_t tile) {
+  const size_t bound = tile + tile / 255 + 64;
+  return (bound + 63) & ~static_cast<size_t>(63);
+}
+
+int64_t ts_compress_bound(size_t n, size_t tile) {
+  if (n == 0) return 0;
+  if (tile == 0 || tile > n) tile = n;
+  const size_t n_tiles = (n + tile - 1) / tile;
+  return static_cast<int64_t>(n_tiles * lz4_slot_stride(tile));
+}
+
+// memmove + fused dual hash used by the compaction pass below: blocks
+// stay cache-hot between the move and the two hash lanes, and forward
+// block order makes the leftward overlapping move safe.
+static void movehash_tile(uint8_t* dst, const uint8_t* src, size_t len,
+                          uint32_t* crc_out, uint64_t* xxh_out,
+                          int want_xxh) {
+  const size_t kBlock = 256u << 10;  // multiple of the 32-byte stripe
+  uint32_t crc = 0;
+  Xxh64State s(0);
+  size_t done = 0;
+  while (done < len) {
+    const size_t blk = (len - done < kBlock) ? (len - done) : kBlock;
+    if (dst != src) std::memmove(dst + done, src + done, blk);
+    crc = ts_crc32c(dst + done, blk, crc);
+    if (want_xxh) {
+      if (done + blk < len) {
+        xxh_consume_stripes(s, reinterpret_cast<const char*>(dst + done),
+                            blk);
+      } else {
+        const size_t c = xxh_consume_stripes(
+            s, reinterpret_cast<const char*>(dst + done), blk);
+        *xxh_out = xxh_finalize(
+            s, 0, reinterpret_cast<const char*>(dst + done) + c, blk - c);
+      }
+    }
+    done += blk;
+  }
+  if (want_xxh && len == 0)
+    *xxh_out = xxh_finalize(s, 0, reinterpret_cast<const char*>(dst), 0);
+  *crc_out = crc;
+}
+
+// Fused per-tile shuffle + LZ4 + dual hash over the COMPRESSED bytes —
+// the compression analog of ts_memcpy_crc_xxh_tiles. Tiles compress in
+// parallel into per-tile slots of ``dst`` (cap from ts_compress_bound),
+// then one sequential compaction pass packs them contiguously while
+// computing each tile's CRC32C (+ XXH64 when want_xxh) of the stored
+// bytes — the values the manifest, the journal's salvage evidence and
+// the upload journal all record, so the dual-hash rule holds unchanged
+// over compressed blobs. A tile whose LZ4 output would not SHRINK it is
+// stored raw (comp_size == raw tile size — the unambiguous marker the
+// decoder keys on, since a stored LZ4 stream is always strictly
+// smaller). Returns the total compressed size.
+int64_t ts_compress_tiles(const void* src_, size_t n, size_t tile, int elem,
+                          void* dst_, size_t dst_cap, int64_t* comp_sizes,
+                          uint32_t* crcs, uint64_t* xxhs, int want_xxh,
+                          int nthreads) {
+  if (n == 0) return 0;
+  if (tile == 0 || tile > n) tile = n;
+  const size_t n_tiles = (n + tile - 1) / tile;
+  const size_t stride = lz4_slot_stride(tile);
+  if (dst_cap < n_tiles * stride) return -1;
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > 16) nthreads = 16;
+  const uint8_t* src = static_cast<const uint8_t*>(src_);
+  uint8_t* dst = static_cast<uint8_t*>(dst_);
+  std::atomic<size_t> next{0};
+  auto work = [&] {
+    std::vector<uint32_t> table(kLz4TableSize);
+    std::vector<uint8_t> shuffled;
+    if (elem > 1) shuffled.resize(tile);
+    for (;;) {
+      const size_t i = next.fetch_add(1);
+      if (i >= n_tiles) return;
+      const size_t off = i * tile;
+      const size_t len = (n - off < tile) ? (n - off) : tile;
+      const uint8_t* in = src + off;
+      if (elem > 1) {
+        byte_shuffle(in, shuffled.data(), len, elem);
+        in = shuffled.data();
+      }
+      uint8_t* slot = dst + i * stride;
+      // Cap at len - 1: output must be strictly smaller than the input
+      // or the tile stores raw (the size-equality marker must stay
+      // unambiguous).
+      const size_t got =
+          lz4_compress_block(in, len, slot, len > 0 ? len - 1 : 0,
+                             table.data());
+      if (got == 0) {
+        std::memcpy(slot, src + off, len);  // raw: ORIGINAL bytes
+        comp_sizes[i] = static_cast<int64_t>(len);
+      } else {
+        comp_sizes[i] = static_cast<int64_t>(got);
+      }
+    }
+  };
+  if (nthreads <= 1 || n_tiles == 1 || n < (8u << 20)) {
+    work();
+  } else {
+    const int nt = (static_cast<size_t>(nthreads) < n_tiles)
+                       ? nthreads
+                       : static_cast<int>(n_tiles);
+    std::vector<std::thread> threads;
+    threads.reserve(nt);
+    for (int t = 0; t < nt; ++t) threads.emplace_back(work);
+    for (auto& t : threads) t.join();
+  }
+  // Compaction + fused hash, strictly left-to-right (each tile's packed
+  // offset is <= its slot offset, so the overlapping move is leftward).
+  size_t out = 0;
+  for (size_t i = 0; i < n_tiles; ++i) {
+    const size_t len = static_cast<size_t>(comp_sizes[i]);
+    uint64_t xxh = 0;
+    movehash_tile(dst + out, dst + i * stride, len, &crcs[i], &xxh,
+                  want_xxh);
+    if (want_xxh) xxhs[i] = xxh;
+    out += len;
+  }
+  return static_cast<int64_t>(out);
+}
+
+// Parallel tile decompress: the restore-side counterpart. ``src`` holds
+// the concatenated compressed tiles (sizes in ``comp_sizes``); each
+// decodes (LZ4 + unshuffle, or a raw copy when comp == raw size) into
+// its row range of ``dst``. Returns total_raw, or -1 on malformed
+// input/size mismatch (the caller surfaces a checksum-style error; the
+// CRC over stored bytes has already vouched for transport integrity).
+int64_t ts_decompress_tiles(const void* src_, size_t src_n,
+                            const int64_t* comp_sizes, size_t n_tiles,
+                            size_t tile_raw, size_t total_raw, void* dst_,
+                            int elem, int nthreads) {
+  if (n_tiles == 0) return total_raw == 0 ? 0 : -1;
+  if (tile_raw == 0) tile_raw = total_raw;
+  const uint8_t* src = static_cast<const uint8_t*>(src_);
+  uint8_t* dst = static_cast<uint8_t*>(dst_);
+  std::vector<size_t> offsets(n_tiles);
+  size_t off = 0;
+  for (size_t i = 0; i < n_tiles; ++i) {
+    offsets[i] = off;
+    if (comp_sizes[i] < 0) return -1;
+    off += static_cast<size_t>(comp_sizes[i]);
+  }
+  if (off != src_n) return -1;
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > 16) nthreads = 16;
+  std::atomic<size_t> next{0};
+  std::atomic<int> bad{0};
+  auto work = [&] {
+    std::vector<uint8_t> scratch;
+    for (;;) {
+      const size_t i = next.fetch_add(1);
+      if (i >= n_tiles || bad.load()) return;
+      const size_t raw_off = i * tile_raw;
+      if (raw_off >= total_raw) {
+        bad.store(1);
+        return;
+      }
+      const size_t raw_len =
+          (total_raw - raw_off < tile_raw) ? (total_raw - raw_off) : tile_raw;
+      const uint8_t* in = src + offsets[i];
+      const size_t clen = static_cast<size_t>(comp_sizes[i]);
+      uint8_t* out = dst + raw_off;
+      if (clen == raw_len) {
+        std::memcpy(out, in, raw_len);  // stored raw
+        continue;
+      }
+      if (clen > raw_len) {
+        bad.store(1);
+        return;
+      }
+      if (elem > 1) {
+        if (scratch.size() < raw_len) scratch.resize(raw_len);
+        const int64_t got =
+            lz4_decompress_block(in, clen, scratch.data(), raw_len);
+        if (got != static_cast<int64_t>(raw_len)) {
+          bad.store(1);
+          return;
+        }
+        byte_unshuffle(scratch.data(), out, raw_len, elem);
+      } else {
+        const int64_t got = lz4_decompress_block(in, clen, out, raw_len);
+        if (got != static_cast<int64_t>(raw_len)) {
+          bad.store(1);
+          return;
+        }
+      }
+    }
+  };
+  if (nthreads <= 1 || n_tiles == 1 || total_raw < (8u << 20)) {
+    work();
+  } else {
+    const int nt = (static_cast<size_t>(nthreads) < n_tiles)
+                       ? nthreads
+                       : static_cast<int>(n_tiles);
+    std::vector<std::thread> threads;
+    threads.reserve(nt);
+    for (int t = 0; t < nt; ++t) threads.emplace_back(work);
+    for (auto& t : threads) t.join();
+  }
+  if (bad.load()) return -1;
+  return static_cast<int64_t>(total_raw);
+}
+
 }  // extern "C"

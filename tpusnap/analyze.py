@@ -68,6 +68,7 @@ _CATEGORY_EXACT = {
     "checksum": "checksum",
     "checksum_late": "checksum",
     "cow_verify": "checksum",
+    "compress": "compress",
     "consume": "consume",
     "budget_wait": "budget_wait",
 }
@@ -85,6 +86,7 @@ WORK_PRIORITY = (
     "dtoh",
     "consume",
     "stage",
+    "compress",
     "checksum",
 )
 # Pure waits: attributed only when no work category is active.
@@ -99,8 +101,9 @@ ADVICE = {
         "the storage backend is the limit — raise TPUSNAP_DIRECT_IO_QD / "
         "TPUSNAP_DIRECT_IO_CHUNK_BYTES for deeper device queues, use "
         "async_take (TPUSNAP_ASYNC_STAGE_WINDOW_BYTES) so training "
-        "overlaps the drain, or target a faster tier (local fs + planned "
-        "write-back upload beats writing through to cloud)"
+        "overlaps the drain, let TPUSNAP_COMPRESS=auto compress bf16/f32 "
+        "tiles when the codec outruns this pipe, or target a faster tier "
+        "(local fs write-back beats writing through to cloud)"
     ),
     "storage_read": (
         "restore is read-bound — raise TPUSNAP_SCRUB_CONCURRENCY-style "
@@ -129,6 +132,13 @@ ADVICE = {
         "restore consume (deserialize + HtoD) dominates — check that "
         "in-place reads are active (they skip the copy-out) and batch "
         "small objects"
+    ),
+    "compress": (
+        "the fused tile codec dominates — the pipe outruns the codec "
+        "here, so flip the policy to bypass (TPUSNAP_COMPRESS=auto does "
+        "this from the probe ceiling; TPUSNAP_COMPRESS=off forces it); "
+        "the codec shares the TPUSNAP_STAGE_THREADS×native copy-thread "
+        "budget, so there is no separate codec-thread knob to raise"
     ),
     "budget_wait": (
         "staging starves on the memory budget with no I/O to blame — "

@@ -784,6 +784,14 @@ class DeltaStream:
                 _record_dedup_hashes=True,
             )
         else:
+            # Micro-commits force DEFENSIVE-CLONE staging (not the
+            # process-wide TPUSNAP_ASYNC_COW default): the stream's
+            # whole point is that training keeps mutating while the
+            # drain runs, with no wait_staged() rendezvous — under COW
+            # every free-running capture would fail on the write-time
+            # mutation check. Per-take parameter, not an env override:
+            # a global flip would race concurrent takes on other
+            # threads into silently paying the full clone pass.
             ctx["pending"] = Snapshot.async_take(
                 path,
                 self._app_state,
@@ -793,6 +801,7 @@ class DeltaStream:
                 incremental_from=self._member_path(prev),
                 _extras=extras,
                 _record_dedup_hashes=True,
+                _force_clone_staging=True,
             )
         return ctx
 

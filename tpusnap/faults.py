@@ -88,6 +88,14 @@ class FaultPlan:
       per-op probability — "cloud down for 10 s mid-drain" as one spec
       token (``outage=write:10``, ``outage=*:5:10``), the failure shape
       the write-back tier's circuit breaker exists for.
+    - ``bandwidth_gbps``: a WRITE-PATH pipe ceiling — a shared token
+      bucket serializes write/write_atomic payload bytes at this GB/s
+      across all concurrent ops, so the plugin behaves like a slow
+      network pipe rather than per-op latency (which would tax
+      compressed and raw bytes identically). The deterministic
+      bandwidth-bound regime the compression auto policy exists for;
+      bench.py's compression section and ci_gate's compression smoke
+      run on it.
     """
 
     seed: int = 0
@@ -99,6 +107,7 @@ class FaultPlan:
     crash_after_op: Optional[Tuple[str, int]] = None
     stall_op: Optional[Tuple[str, int, float]] = None
     outage: Optional[Tuple[str, float, float]] = None
+    bandwidth_gbps: float = 0.0
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
@@ -117,6 +126,8 @@ class FaultPlan:
                 plan.latency_sec = float(value) / 1000.0
             elif key == "latency_sec":
                 plan.latency_sec = float(value)
+            elif key == "bandwidth_gbps":
+                plan.bandwidth_gbps = float(value)
             elif key in ("seed", "transient_per_op", "transient_every"):
                 setattr(plan, key, int(value))
             elif key in ("torn_writes", "short_reads"):
@@ -184,6 +195,9 @@ class _FaultState:
     # and the edge-trigger flag for its one flight breadcrumb.
     outage_anchor: Optional[float] = None
     outage_announced: bool = False
+    # Write-bandwidth token bucket: the monotonic time the shared pipe
+    # frees up (concurrent writers queue behind it, like a real link).
+    bw_release: float = 0.0
 
 
 # Monotonic seam for the outage window (tests pin it to a fake clock so
@@ -341,6 +355,25 @@ class FaultInjectionStoragePlugin(StoragePlugin):
             f"({t - start:.2f}s into a {duration:.2f}s window)"
         )
 
+    async def _throttle_bandwidth(self, nbytes: int) -> None:
+        """Serialize ``nbytes`` of write payload through the planned
+        pipe ceiling: a shared token bucket (not per-op sleep), so N
+        concurrent writes still drain at ``bandwidth_gbps`` aggregate
+        and compressed payloads genuinely cost fewer pipe-seconds."""
+        bw = self.plan.bandwidth_gbps
+        if bw <= 0 or nbytes <= 0:
+            return
+        cost = nbytes / (bw * 1e9)
+        st = self._state
+        with st.lock:
+            start = max(_mono(), st.bw_release)
+            st.bw_release = start + cost
+            release = st.bw_release
+        delay = release - _mono()
+        if delay > 0:
+            telemetry.incr("faults.bandwidth_throttled")
+            await asyncio.sleep(delay)
+
     async def _pre(self, kind: str, path: str) -> bool:
         """Apply latency + injected stalls; return whether this attempt
         must fail."""
@@ -387,6 +420,7 @@ class FaultInjectionStoragePlugin(StoragePlugin):
                     f"of {write_io.path!r} persisted"
                 )
             raise InjectedFaultError(f"injected write failure: {write_io.path!r}")
+        await self._throttle_bandwidth(len(write_io.buf))
         await self.inner.write(write_io)
         self._record_success(kind)
 
@@ -399,6 +433,7 @@ class FaultInjectionStoragePlugin(StoragePlugin):
             raise InjectedFaultError(
                 f"injected write_atomic failure: {write_io.path!r}"
             )
+        await self._throttle_bandwidth(len(write_io.buf))
         await self.inner.write_atomic(write_io, durable=durable)
         self._record_success(kind)
 

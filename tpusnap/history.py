@@ -138,6 +138,25 @@ def event_from_summary(kind: str, summary: Dict[str, Any]) -> Dict[str, Any]:
     # pipelined-staging win cannot silently regress.
     if isinstance(summary.get("async_blocked_s"), (int, float)):
         ev["async_blocked_s"] = round(float(summary["async_blocked_s"]), 6)
+    # Fused tile compression: the take's resolved policy decision plus
+    # realized ratio/codec throughput. Flat scalars so `history --check
+    # --metric compress_ratio` (or the bench's effective-GB/s metrics)
+    # trend and gate like everything else; absent on bypassed takes
+    # keeps old/new event populations comparable.
+    comp = summary.get("compress")
+    if isinstance(comp, dict):
+        ev["compress_decision"] = comp.get("decision")
+        ev["compress_reason"] = comp.get("reason")
+        if comp.get("codec_gbps"):
+            ev["compress_codec_gbps"] = comp["codec_gbps"]
+        if comp.get("pipe_gbps") is not None:
+            ev["compress_pipe_gbps"] = comp["pipe_gbps"]
+    c_in = counters.get("compress.bytes_in", 0)
+    c_out = counters.get("compress.bytes_out", 0)
+    if c_in and c_out:
+        ev["compress_bytes_in"] = int(c_in)
+        ev["compress_bytes_out"] = int(c_out)
+        ev["compress_ratio"] = round(c_in / c_out, 4)
     # Storage-boundary write-latency quantiles from the take's log2
     # histograms (merged across plugin classes): *_s metrics, so
     # `history --check --metric storage_write_p99_s` gates tail latency

@@ -184,6 +184,39 @@ def _tensor_blobs(path: str, entry: TensorEntry, detail: str = "") -> Iterator[_
     corrupted tile and its memory footprint stays at tile size); plain
     entries are one range."""
     base = entry.byte_range[0] if entry.byte_range is not None else 0
+    if entry.codec:
+        # Compressed entry: the STORED blob is the concatenation of
+        # independently compressed tiles, and every recorded checksum is
+        # over the stored bytes — so a scrub reads compressed ranges
+        # (tile i at sum(comp_tile_sizes[:i])) and verifies them exactly
+        # like raw tiles. Bit-rot in a compressed tile is named per tile.
+        sizes = [int(s) for s in (entry.comp_tile_sizes or [])]
+        if (
+            entry.tile_checksums
+            and entry.tile_rows
+            and len(sizes) == len(entry.tile_checksums)
+        ):
+            off = base
+            for i, tile_crc in enumerate(entry.tile_checksums):
+                yield _Blob(
+                    manifest_path=path,
+                    location=entry.location,
+                    byte_range=(off, off + sizes[i]),
+                    checksum=tile_crc,
+                    detail=(detail + " " if detail else "")
+                    + f"comp tile {i} ({entry.codec})",
+                )
+                off += sizes[i]
+            return
+        yield _Blob(
+            manifest_path=path,
+            location=entry.location,
+            byte_range=(base, base + sum(sizes)),
+            checksum=entry.checksum,
+            detail=(detail + " " if detail else "")
+            + f"compressed ({entry.codec})",
+        )
+        return
     nbytes = tensor_nbytes(entry.dtype, entry.shape)
     if entry.tile_checksums and entry.tile_rows:
         n_rows = entry.shape[0]
@@ -452,10 +485,21 @@ def _rowwise_fold(entry) -> Optional[str]:
 
     algo = _native.checksum_algorithm()
     if isinstance(entry, TensorEntry):
+        if entry.codec:
+            # Compressed: the checksum is over STORED bytes — only
+            # comparable against another entry of the same codec/layout
+            # (the fingerprint's geometry carries the codec).
+            return None
         if entry.checksum and entry.checksum.startswith(algo + ":"):
             return entry.checksum
         return None
     if not isinstance(entry, ChunkedTensorEntry) or not entry.chunks:
+        return None
+    if any(c.tensor.codec for c in entry.chunks):
+        # Compressed chunks: per-chunk checksums are over stored bytes
+        # at compressed offsets; a row-length CRC combine would be
+        # meaningless. Compared chunk-by-chunk with codec-aware
+        # geometry instead.
         return None
     row_nbytes = (
         tensor_nbytes(entry.dtype, entry.shape[1:])
@@ -510,16 +554,29 @@ def _entry_fingerprint(entry: Entry):
                 folded,
             )
     if isinstance(entry, TensorEntry):
+        # Compressed entries' checksums are over STORED bytes, so they
+        # only compare against entries of the same codec: raw-vs-
+        # compressed of identical content must read undecidable (a
+        # geometry mismatch), never falsely "changed".
+        geom = ("dense", entry.codec) if entry.codec else ("dense",)
         return (
             ("tensor", entry.dtype, tuple(entry.shape)),
-            ("dense",),
+            geom,
             entry.checksum,
         )
     if isinstance(entry, ChunkedTensorEntry):
         parts = tuple(c.tensor.checksum for c in entry.chunks)
         return (
             ("tensor", entry.dtype, tuple(entry.shape)),
-            ("chunked", tuple((tuple(c.offsets), tuple(c.sizes)) for c in entry.chunks)),
+            (
+                "chunked",
+                tuple(
+                    (tuple(c.offsets), tuple(c.sizes), c.tensor.codec)
+                    if c.tensor.codec
+                    else (tuple(c.offsets), tuple(c.sizes))
+                    for c in entry.chunks
+                ),
+            ),
             None if any(p is None for p in parts) else parts,
         )
     if isinstance(entry, ShardedEntry):

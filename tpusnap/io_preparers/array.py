@@ -85,9 +85,24 @@ class ArrayBufferStager(BufferStager):
         array_prepare_func: Optional[Callable[[ArrayLike, bool], ArrayLike]] = None,
         dedup_entry: Optional[TensorEntry] = None,
         record_dedup_hashes: bool = False,
+        compressible: bool = True,
     ) -> None:
         self.arr = arr
         self.is_async_snapshot = is_async_snapshot
+        # Fused tile compression (tpusnap.compress): the take's policy
+        # sets ``compress_codec`` on eligible stagers after batching;
+        # staging then runs the fused shuffle+LZ4+dual-hash pass and
+        # the staged buffer IS the compressed blob. ``compressible``
+        # is construction-time eligibility: sharded shards opt out
+        # (their restore path reads arbitrary overlap sub-ranges,
+        # impossible at compressed-tile grain).
+        self.compressible = compressible
+        self.compress_codec: Optional[str] = None
+        # Per-take clone-staging override, armed by the take after
+        # batching (delta micro-commits force defensive clones: their
+        # free-running captures cannot rendezvous with the training
+        # thread, so COW's write-time verify would fail every commit).
+        self.force_clone = False
         # Manifest entry to annotate with the stage-time checksum. The
         # manifest is gathered after staging completes, so the value lands
         # in the committed metadata.
@@ -112,7 +127,7 @@ class ArrayBufferStager(BufferStager):
         # (snapshot._LateChecksums). Incremental dedup needs hashes at
         # stage time and never defers.
         self.defer_checksums = False
-        # Copy-on-write staging (TPUSNAP_ASYNC_COW, opt-in): set by
+        # Copy-on-write staging (TPUSNAP_ASYNC_COW, the default): set by
         # _stage_blocking when it returns the LIVE host bytes instead of
         # a defensive clone. The write pipeline then calls
         # verify_cow_after_write once the storage write completes; a
@@ -164,6 +179,14 @@ class ArrayBufferStager(BufferStager):
             rec.record_span("dtoh", dtoh_t0, rec.now() - dtoh_t0, bytes=host.nbytes)
         mv = array_as_memoryview(host)
         want_crc = self.entry is not None and not is_checksum_disabled()
+        if self.compress_codec is not None and want_crc:
+            # Fused tile compression: the staged buffer is the
+            # compressed blob — fresh memory that never aliases the
+            # live array, so async takes need neither the defensive
+            # clone nor the COW write-time re-verify, and dedup (when
+            # armed) compares hashes of the compressed bytes. Handles
+            # its own dedup/skip decision.
+            return self._stage_compressed(mv)
         if want_crc and self.dedup_entry is not None:
             # Incremental dedup: hash first (the expected outcome is
             # "unchanged", where no clone and no write happen at all).
@@ -193,7 +216,7 @@ class ArrayBufferStager(BufferStager):
             if clone:
                 from ..knobs import is_async_cow_enabled
 
-                if is_async_cow_enabled():
+                if is_async_cow_enabled() and not self.force_clone:
                     # COW: checksums already recorded from the live
                     # bytes — skip the clone and verify at write time.
                     self.cow_pending = True
@@ -217,8 +240,8 @@ class ArrayBufferStager(BufferStager):
             # write path (late_checksum).
             from ..knobs import is_async_cow_enabled
 
-            if want_crc and is_async_cow_enabled():
-                # COW (opt-in): no clone at all — record the fused hash
+            if want_crc and is_async_cow_enabled() and not self.force_clone:
+                # COW (the default): no clone at all — record the fused hash
                 # of the LIVE bytes now (overriding deferral: the
                 # stage-time value is the mutation-detection reference)
                 # and have the write pipeline re-verify after the
@@ -372,13 +395,121 @@ class ArrayBufferStager(BufferStager):
                     f"XXH64 tile {i} mismatch for {entry.location!r}"
                 )
 
+    def _stage_compressed(self, mv: memoryview) -> BufferType:
+        """Fused shuffle+LZ4+dual-hash staging pass: one read of the
+        live bytes, compressed tiles + their checksums/dedup hashes out
+        (all recorded over the STORED bytes — the journal/salvage/
+        upload-journal evidence rule holds unchanged). The staged
+        buffer is copied to a right-sized pool buffer so resident bytes
+        match what the scheduler's budget credits back."""
+        from .. import _native, telemetry
+        from ..compress import codec_elem
+        from ..knobs import get_native_copy_threads
+
+        codec = self.compress_codec
+        entry = self.entry
+        tile_rows, row_nbytes = _tile_geometry(entry, mv.nbytes)
+        tile_nbytes = tile_rows * row_nbytes if tile_rows else mv.nbytes
+        want_dedup = _want_dedup_hashes(
+            self.record_dedup_hashes, tile_rows, mv.nbytes
+        ) or self.dedup_entry is not None
+        rec = telemetry.current()
+        # Raw-hash fast skip: an unchanged blob must cost a multi-GB/s
+        # hash pass, not a codec pass (a mostly-frozen model streaming
+        # micro-commits over a slow pipe would otherwise re-compress
+        # the whole model per cadence interval to write ~zero bytes).
+        # The codec is deterministic, so equal RAW bytes imply equal
+        # stored bytes — the base's recorded dual raw hash (96 bits,
+        # stronger than the 64-bit skip-evidence floor) licenses
+        # adopting its stored blob and every recorded field wholesale.
+        raw_hash = _raw_dual_hash(mv) if want_dedup else None
+        prev = self.dedup_entry
+        if (
+            prev is not None
+            and raw_hash is not None
+            and getattr(prev, "uncompressed_dedup_hash", None) == raw_hash
+            and getattr(prev, "codec", None) == codec
+            and prev.checksum is not None
+            and prev.dtype == entry.dtype
+            and list(prev.shape) == list(entry.shape)
+            and prev.serializer == entry.serializer
+        ):
+            from ..io_types import SKIP_WRITE
+
+            entry.location = prev.location
+            entry.byte_range = (
+                list(prev.byte_range)
+                if prev.byte_range is not None
+                else None
+            )
+            _annotate_from_dedup_base(entry, prev)
+            telemetry.incr("compress.raw_dedup_skips", rec=rec)
+            return SKIP_WRITE
+        t0 = rec.now() if rec is not None else 0.0
+        out, comp_sizes, crcs, xxhs = _native.compress_tiles(
+            mv,
+            tile_nbytes,
+            codec_elem(codec),
+            want_dedup,
+            nthreads=get_native_copy_threads(),
+        )
+        if rec is not None:
+            rec.record_span(
+                "compress",
+                t0,
+                rec.now() - t0,
+                bytes=mv.nbytes,
+                out_bytes=out.nbytes,
+                codec=codec,
+            )
+        telemetry.incr("compress.bytes_in", mv.nbytes, rec=rec)
+        telemetry.incr("compress.bytes_out", out.nbytes, rec=rec)
+        telemetry.incr("compress.blobs", rec=rec)
+        _annotate_compressed(
+            entry, codec, mv.nbytes, comp_sizes, crcs, tile_rows, xxhs
+        )
+        if raw_hash is not None:
+            # Write-skip evidence for the NEXT incremental take's
+            # raw-hash fast path (see above) — never storage evidence.
+            entry.uncompressed_dedup_hash = raw_hash
+        if self.dedup_entry is not None and dedup_entries_match(
+            entry, self.dedup_entry
+        ):
+            # Deterministic codec: unchanged input bytes yield identical
+            # compressed bytes, so the compressed-hash comparison is as
+            # strong as the uncompressed one (a base written by a
+            # different codec/build conservatively rewrites — codec is
+            # part of the match identity).
+            from ..io_types import SKIP_WRITE
+
+            entry.location = self.dedup_entry.location
+            entry.byte_range = (
+                list(self.dedup_entry.byte_range)
+                if self.dedup_entry.byte_range is not None
+                else None
+            )
+            return SKIP_WRITE
+        # `out` slices a worst-case-bound allocation; re-home the
+        # compressed bytes in a right-sized (aligned, O_DIRECT-ready)
+        # pool buffer so the big bound buffer is not pinned until the
+        # storage write drains.
+        final = _acquire_clone_buffer(out.nbytes)
+        _native.memcpy(final, out, nthreads=get_native_copy_threads())
+        return final
+
     def get_staging_cost_bytes(self) -> int:
         n = self.get_planned_bytes()
+        if self.compress_codec is not None:
+            # Compressed staging transiently holds the worst-case-bound
+            # output buffer plus the right-sized staged copy; 2x the
+            # payload bounds both (and matches the async-clone model).
+            return 2 * n
         if self.is_async_snapshot:
             from ..knobs import is_async_cow_enabled, is_checksum_disabled
 
             if (
                 is_async_cow_enabled()
+                and not self.force_clone
                 and self.entry is not None
                 and not is_checksum_disabled()
             ):
@@ -531,6 +662,12 @@ def dedup_entries_match(new: TensorEntry, prev: TensorEntry) -> bool:
         and new.serializer == prev.serializer
         and new.tile_rows == prev.tile_rows
         and new.tile_checksums == prev.tile_checksums
+        # Compressed blobs: hashes are over STORED bytes, so identity
+        # includes the codec and the stored layout — a codec change
+        # between takes (or compressed vs raw) conservatively rewrites.
+        and getattr(new, "codec", None) == getattr(prev, "codec", None)
+        and getattr(new, "comp_tile_sizes", None)
+        == getattr(prev, "comp_tile_sizes", None)
     ):
         return False
     if new.tile_checksums:
@@ -639,6 +776,88 @@ def _annotate_checksums(
 
 
 _XXH_MASK = (1 << 64) - 1
+
+
+def _annotate_compressed(
+    entry: TensorEntry,
+    codec: str,
+    raw_nbytes: int,
+    comp_sizes: List[int],
+    tile_crcs: List[int],
+    tile_rows: int,
+    tile_xxhs: Optional[List[int]] = None,
+) -> None:
+    """Record the compressed-blob manifest fields: codec identity,
+    logical size, per-tile stored sizes, and checksums/dedup hashes
+    computed over the STORED (compressed) bytes — the whole-blob value
+    is the CRC combine over the compressed tile lengths, so scrub, the
+    journal's written-bytes evidence and restore verification all agree
+    byte-for-byte with what is on disk."""
+    from .. import _native
+
+    algo = _native.checksum_algorithm()
+    entry.codec = codec
+    entry.uncompressed_nbytes = raw_nbytes
+    entry.comp_tile_sizes = [int(s) for s in comp_sizes]
+    if tile_rows:
+        entry.tile_rows = tile_rows
+        entry.tile_checksums = [
+            f"{algo}:{crc & 0xFFFFFFFF:08x}" for crc in tile_crcs
+        ]
+        entry.checksum = (
+            f"{algo}:{_fold_crcs(tile_crcs, entry.comp_tile_sizes):08x}"
+        )
+        if tile_xxhs is not None:
+            dalgo = _native.dedup_hash_algorithm()
+            entry.tile_dedup_hashes = [
+                f"{dalgo}:{x & _XXH_MASK:016x}" for x in tile_xxhs
+            ]
+    else:
+        entry.checksum = f"{algo}:{tile_crcs[0] & 0xFFFFFFFF:08x}"
+        if tile_xxhs is not None:
+            dalgo = _native.dedup_hash_algorithm()
+            entry.dedup_hash = f"{dalgo}:{tile_xxhs[0] & _XXH_MASK:016x}"
+
+
+def _raw_dual_hash(mv: memoryview) -> str:
+    """Dual hash of a compressed stager's RAW payload bytes —
+    ``uncompressed_dedup_hash`` write-skip evidence. One fused-speed
+    read per algorithm; only computed on dedup-recording takes."""
+    from .. import _native
+
+    algo = _native.checksum_algorithm()
+    crc = _native.crc32c(mv) & 0xFFFFFFFF
+    xxh = _native.xxh64(mv) & _XXH_MASK
+    return f"{algo}:{crc:08x}+xxh64:{xxh:016x}"
+
+
+def _annotate_from_dedup_base(entry: TensorEntry, prev: TensorEntry) -> None:
+    """A raw-hash fast skip never ran the codec, so the entry adopts
+    the base's recorded representation wholesale — codec identity,
+    stored layout and every stored-bytes integrity field. The codec is
+    deterministic, so these are byte-identical to what re-compressing
+    would have produced."""
+    entry.codec = prev.codec
+    entry.uncompressed_nbytes = prev.uncompressed_nbytes
+    entry.comp_tile_sizes = (
+        list(prev.comp_tile_sizes)
+        if prev.comp_tile_sizes is not None
+        else None
+    )
+    entry.tile_rows = prev.tile_rows
+    entry.checksum = prev.checksum
+    entry.tile_checksums = (
+        list(prev.tile_checksums)
+        if prev.tile_checksums is not None
+        else None
+    )
+    entry.dedup_hash = prev.dedup_hash
+    entry.tile_dedup_hashes = (
+        list(prev.tile_dedup_hashes)
+        if prev.tile_dedup_hashes is not None
+        else None
+    )
+    entry.uncompressed_dedup_hash = prev.uncompressed_dedup_hash
 
 
 def _record_checksums(
@@ -954,6 +1173,10 @@ class ArrayIOPreparer:
         logical_path: str = "",
     ) -> Tuple[List[ReadReq], Future]:
         fut: Future = Future()
+        if entry.codec:
+            return ArrayIOPreparer._prepare_compressed_read(
+                entry, obj_out, buffer_size_limit_bytes, fut, logical_path
+            )
         nbytes = tensor_nbytes(entry.dtype, entry.shape)
         if (
             buffer_size_limit_bytes is not None
@@ -1074,6 +1297,257 @@ class ArrayIOPreparer:
                 )
             )
         return read_reqs, fut
+
+
+    @staticmethod
+    def _prepare_compressed_read(
+        entry: TensorEntry,
+        obj_out: Optional[ArrayLike],
+        buffer_size_limit_bytes: Optional[int],
+        fut: Future,
+        logical_path: str = "",
+    ) -> Tuple[List[ReadReq], Future]:
+        """Read path for a codec entry: compressed tiles are read by
+        byte range (grouped so each group's DECOMPRESSED bytes fit the
+        memory budget — the stored tile is the random-access unit, so
+        ``read_object`` and budget-tiled restores work at tile grain),
+        verified against the combined compressed-tile checksum, then
+        fused-decompressed (LZ4 + unshuffle, parallel across tiles)
+        straight into the destination rows."""
+        shape = entry.shape
+        raw_nbytes = entry.uncompressed_nbytes or tensor_nbytes(
+            entry.dtype, shape
+        )
+        sizes = [int(s) for s in (entry.comp_tile_sizes or [])]
+        tile_rows = entry.tile_rows or 0
+        n_rows = shape[0] if shape else 0
+        row_nbytes = raw_nbytes // n_rows if n_rows else 0
+        tile_raw = tile_rows * row_nbytes if tile_rows else raw_nbytes
+        n_tiles = max(len(sizes), 1)
+        if not sizes:
+            raise IOError(
+                f"compressed entry {entry.location!r} records no "
+                "comp_tile_sizes — the snapshot metadata is inconsistent"
+            )
+        # The tile list must COVER the payload: each group below only
+        # verifies its own range, so a truncated comp_tile_sizes (buggy
+        # external rewriter) would otherwise "restore" with the tail of
+        # the destination never written — every per-group checksum
+        # green, result garbage.
+        from ..compress import check_tile_coverage
+
+        check_tile_coverage(entry.location, len(sizes), raw_nbytes, tile_raw)
+        if isinstance(obj_out, np.ndarray) and (
+            dtype_to_string(obj_out.dtype) == entry.dtype
+            and list(obj_out.shape) == list(shape)
+            and obj_out.flags.writeable
+        ):
+            host_out = obj_out
+            in_place = True
+        else:
+            from .. import _native
+            from ..serialization import string_to_dtype
+
+            host_out = _native.empty_advised(
+                shape, string_to_dtype(entry.dtype)
+            )
+            in_place = False
+        dest_mv = array_as_memoryview(host_out)
+        if dest_mv.readonly:  # zero-size arrays come back read-only
+            dest_mv = None
+        base = entry.byte_range[0] if entry.byte_range is not None else 0
+        from ..compress import comp_tile_offsets
+
+        offsets = comp_tile_offsets(sizes)
+        # Group consecutive tiles while the group's decompressed bytes
+        # fit the budget (>= 1 tile per group: the stored tile is the
+        # minimum readable unit, integrity over budget — same policy as
+        # the uncompressed tiled read).
+        groups: List[Tuple[int, int]] = []
+        t0 = 0
+        while t0 < n_tiles:
+            t1 = t0 + 1
+            if buffer_size_limit_bytes is not None:
+                while (
+                    t1 < n_tiles
+                    and (t1 + 1 - t0) * tile_raw <= buffer_size_limit_bytes
+                ):
+                    t1 += 1
+            else:
+                t1 = n_tiles
+            groups.append((t0, t1))
+            t0 = t1
+        remaining = {"count": len(groups)}
+        from ..compress import combined_comp_checksum
+        from ..knobs import is_checksum_disabled
+
+        verify = not is_checksum_disabled()
+        read_reqs: List[ReadReq] = []
+        for g0, g1 in groups:
+            comp_start = base + offsets[g0]
+            comp_end = base + offsets[g1 - 1] + sizes[g1 - 1]
+            expected = (
+                combined_comp_checksum(entry, g0, g1) if verify else None
+            )
+            raw_start = g0 * tile_raw
+            raw_end = min(g1 * tile_raw, raw_nbytes)
+            consumer = _CompressedConsumer(
+                entry=entry,
+                dest_slice=(
+                    dest_mv[raw_start:raw_end] if dest_mv is not None else None
+                ),
+                comp_sizes=sizes[g0:g1],
+                tile_raw=tile_raw,
+                raw_len=raw_end - raw_start,
+                remaining=remaining,
+                fut=fut,
+                host_out=host_out,
+                obj_out=obj_out,
+                in_place=in_place,
+                expected_checksum=expected,
+                location=(
+                    f"{logical_path or entry.location} "
+                    f"(comp tiles {g0}:{g1})"
+                ),
+            )
+            read_reqs.append(
+                ReadReq(
+                    path=entry.location,
+                    byte_range=(comp_start, comp_end),
+                    buffer_consumer=consumer,
+                    want_crc=expected is not None,
+                )
+            )
+        return read_reqs, fut
+
+
+class _CompressedConsumer(BufferConsumer):
+    """Consumes one group of compressed tiles: verify the CRC of the
+    stored bytes (the fused read-time value when the plugin computed
+    one, else one hash pass), then fused-decompress into the
+    destination rows. Completion bookkeeping mirrors _TileConsumer."""
+
+    def __init__(
+        self,
+        entry: TensorEntry,
+        dest_slice: Optional[memoryview],
+        comp_sizes: List[int],
+        tile_raw: int,
+        raw_len: int,
+        remaining: dict,
+        fut: Future,
+        host_out,
+        obj_out,
+        in_place: bool,
+        expected_checksum: Optional[str],
+        location: str,
+    ) -> None:
+        self.entry = entry
+        self.dest_slice = dest_slice
+        self.comp_sizes = comp_sizes
+        self.tile_raw = tile_raw
+        self.raw_len = raw_len
+        self.remaining = remaining
+        self.fut = fut
+        self.host_out = host_out
+        self.obj_out = obj_out
+        self.in_place = in_place
+        self.expected_checksum = expected_checksum
+        self.location = location
+        self.comp_nbytes = sum(comp_sizes)
+
+    async def consume_read_io(self, read_io, executor: Optional[Executor] = None) -> None:
+        buf = read_io.buf.getbuffer()
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            await loop.run_in_executor(
+                executor,
+                self._consume_blocking,
+                buf,
+                read_io.crc32c,
+                read_io.crc_algo,
+            )
+        else:
+            self._consume_blocking(buf, read_io.crc32c, read_io.crc_algo)
+        await self._after_consume(executor)
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            await loop.run_in_executor(
+                executor, self._consume_blocking, buf, None, None
+            )
+        else:
+            self._consume_blocking(buf, None, None)
+        await self._after_consume(executor)
+
+    def _consume_blocking(self, buf: BufferType, crc, crc_algo) -> None:
+        from .. import _native
+        from ..knobs import get_native_copy_threads
+
+        mv = memoryview(buf).cast("B")
+        if self.expected_checksum is not None:
+            if crc is not None and crc_algo:
+                # Fused read-time hash: verify a 4-byte value, no
+                # second pass over the compressed bytes.
+                _native.verify_checksum_value(
+                    crc, crc_algo, self.expected_checksum, self.location
+                )
+            else:
+                _native.verify_checksum(
+                    mv, self.expected_checksum, self.location
+                )
+        if mv.nbytes != self.comp_nbytes:
+            raise IOError(
+                f"short read: got {mv.nbytes} of {self.comp_nbytes} "
+                f"compressed bytes for {self.location} — the blob is "
+                "truncated"
+            )
+        if self.dest_slice is None:
+            return  # zero-size destination: nothing to decode
+        from ..compress import codec_elem
+
+        try:
+            _native.decompress_tiles(
+                mv,
+                self.comp_sizes,
+                self.tile_raw,
+                self.raw_len,
+                codec_elem(self.entry.codec),
+                self.dest_slice,
+                nthreads=get_native_copy_threads(),
+            )
+        except _native.CompressionError as e:
+            raise _native.CompressionError(
+                f"{self.location}: {e} (stored checksum verified — the "
+                "blob was written malformed, not corrupted in transit)"
+            ) from e
+
+    async def _after_consume(self, executor: Optional[Executor] = None) -> None:
+        self.remaining["count"] -= 1
+        if self.remaining["count"] != 0:
+            return
+        if self.in_place:
+            self.fut.obj = self.host_out
+            return
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            self.fut.obj = await loop.run_in_executor(
+                executor,
+                finalize_into_target,
+                self.host_out,
+                self.obj_out,
+                True,
+            )
+        else:
+            self.fut.obj = finalize_into_target(
+                self.host_out, self.obj_out, True
+            )
+
+    def get_consuming_cost_bytes(self) -> int:
+        return self.raw_len + self.comp_nbytes
 
 
 class _TileConsumer(BufferConsumer):

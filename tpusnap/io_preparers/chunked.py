@@ -164,6 +164,26 @@ class ChunkedArrayIOPreparer:
             r0 = chunk.offsets[0]
             r1 = r0 + chunk.sizes[0]
             tensor_entry = chunk.tensor
+            if tensor_entry.codec:
+                # Compressed chunk: its own standalone compressed blob
+                # — read the stored tiles, verify the chunk checksum
+                # (over the stored bytes), fused-decompress into the
+                # chunk's rows. Shares the array-wide remaining/fut
+                # bookkeeping with the plain-chunk consumers.
+                read_reqs.append(
+                    _compressed_chunk_read_req(
+                        tensor_entry,
+                        host_out,
+                        r0,
+                        r1,
+                        remaining,
+                        fut,
+                        obj_out,
+                        in_place,
+                        logical_path,
+                    )
+                )
+                continue
             byte_range = (
                 tuple(tensor_entry.byte_range)
                 if tensor_entry.byte_range is not None
@@ -201,6 +221,65 @@ class ChunkedArrayIOPreparer:
         return read_reqs, fut
 
 
+def _compressed_chunk_read_req(
+    tensor_entry: TensorEntry,
+    host_out,
+    r0: int,
+    r1: int,
+    remaining: dict,
+    fut,
+    obj_out,
+    in_place: bool,
+    logical_path: str,
+) -> ReadReq:
+    from ..knobs import is_checksum_disabled
+    from .array import _CompressedConsumer, array_as_memoryview
+
+    sizes = [int(s) for s in (tensor_entry.comp_tile_sizes or [])]
+    raw_nbytes = tensor_entry.uncompressed_nbytes or tensor_nbytes(
+        tensor_entry.dtype, tensor_entry.shape
+    )
+    n_rows = tensor_entry.shape[0] if tensor_entry.shape else 0
+    row_nbytes = raw_nbytes // n_rows if n_rows else 0
+    tile_raw = (
+        (tensor_entry.tile_rows or 0) * row_nbytes
+        if tensor_entry.tile_rows
+        else raw_nbytes
+    )
+    from ..compress import check_tile_coverage
+
+    check_tile_coverage(
+        tensor_entry.location, len(sizes), raw_nbytes, tile_raw
+    )
+    row_slice = host_out[r0:r1]
+    dest_mv = array_as_memoryview(row_slice)
+    expected = (
+        tensor_entry.checksum if not is_checksum_disabled() else None
+    )
+    consumer = _CompressedConsumer(
+        entry=tensor_entry,
+        dest_slice=dest_mv if not dest_mv.readonly else None,
+        comp_sizes=sizes,
+        tile_raw=tile_raw,
+        raw_len=raw_nbytes,
+        remaining=remaining,
+        fut=fut,
+        host_out=host_out,
+        obj_out=obj_out,
+        in_place=in_place,
+        expected_checksum=expected,
+        location=(
+            f"{logical_path or tensor_entry.location} (chunk @ row {r0})"
+        ),
+    )
+    return ReadReq(
+        path=tensor_entry.location,
+        byte_range=(0, sum(sizes)),
+        buffer_consumer=consumer,
+        want_crc=expected is not None,
+    )
+
+
 def tile_prev_map(
     prev_entry, dtype: str, shape: List[int]
 ) -> Optional[Tuple[int, dict]]:
@@ -228,6 +307,11 @@ def tile_prev_map(
         and prev_entry.tile_checksums
         and prev_entry.tile_dedup_hashes
         and len(prev_entry.tile_checksums) == len(prev_entry.tile_dedup_hashes)
+        # Compressed bases: tile hashes are over STORED bytes at
+        # compressed offsets — per-tile byte_range references into the
+        # raw layout would be wrong. Dedup against a compressed base
+        # stays whole-blob (dedup_entries_match compares codec+layout).
+        and not prev_entry.codec
     ):
         t = prev_entry.tile_rows
         n_rows = shape[0]
@@ -277,6 +361,7 @@ def tile_prev_map(
                 or c.tensor.checksum is None
                 or c.tensor.dedup_hash is None
                 or c.tensor.tile_rows  # oversized chunk: grid not tile-sized
+                or c.tensor.codec  # compressed chunk: blob-grain dedup only
             ):
                 return None
             out[(tuple(c.offsets), tuple(c.sizes))] = c.tensor

@@ -186,6 +186,11 @@ class ShardedArrayIOPreparer:
                                 (tuple(sub_off), tuple(sub_sz))
                             ),
                             record_dedup_hashes=record_dedup_hashes,
+                            # Shard restores read arbitrary overlap
+                            # sub-ranges (resharding) — impossible at
+                            # compressed-tile grain, so shards bypass
+                            # the codec by construction.
+                            compressible=False,
                         ),
                     )
                 )

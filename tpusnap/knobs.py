@@ -58,6 +58,8 @@ _TIER_OP_DEADLINE_ENV_VAR = "TPUSNAP_TIER_OP_DEADLINE_S"
 _TIER_OUTAGE_THRESHOLD_ENV_VAR = "TPUSNAP_TIER_OUTAGE_THRESHOLD"
 _TIER_BACKOFF_CAP_ENV_VAR = "TPUSNAP_TIER_BACKOFF_CAP_S"
 _TIER_LOCAL_RETENTION_ENV_VAR = "TPUSNAP_TIER_LOCAL_RETENTION_S"
+_COMPRESS_ENV_VAR = "TPUSNAP_COMPRESS"
+_COMPRESS_MIN_BLOB_ENV_VAR = "TPUSNAP_COMPRESS_MIN_BLOB_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -362,17 +364,20 @@ def get_async_stage_window_bytes() -> Optional[int]:
 
 def is_async_cow_enabled() -> bool:
     """Copy-on-write async staging for host-aliasing arrays (numpy /
-    pinned_host / CPU-backend device arrays), OPT-IN: instead of the
+    pinned_host / CPU-backend device arrays), ON BY DEFAULT since
+    round 14 (ROADMAP 5: the 20 GB take spent 13.5 of 14.5 s in the
+    clone pass — frozen layers should clone nothing): instead of the
     defensive clone, the blocked window records the fused
     CRC32C(+XXH64) hash of the live bytes and the write path re-hashes
     after the storage write — a mismatch (the caller mutated the array
     mid-take) fails the take loudly instead of committing torn data.
-    Frozen layers (the common case for the biggest arrays) then clone
-    NOTHING: the blocked window pays one read pass, no allocation, no
-    copy. Off by default because it weakens the defensive-clone
-    guarantee from "mutation cannot corrupt" to "mutation is detected
-    and fails the take"."""
-    return os.environ.get(_ASYNC_COW_ENV_VAR, "0") == "1"
+    ``PendingSnapshot.staged()/wait_staged()`` are COW-aware (they
+    report THIS RANK's write drain), so ``staged() ⟹ safe to mutate``
+    holds exactly as before. ``TPUSNAP_ASYNC_COW=0`` is the escape
+    hatch back to defensive cloning, which strengthens the guarantee
+    from "mutation is detected and fails the take" to "mutation cannot
+    corrupt" at the cost of a full clone pass per take."""
+    return os.environ.get(_ASYNC_COW_ENV_VAR, "1") != "0"
 
 
 def is_probe_enabled() -> bool:
@@ -541,6 +546,48 @@ def get_tier_local_retention_s() -> float:
     wants the last N minutes of checkpoints restorable at local-disk
     speed sets this to that window."""
     return max(0.0, _get_float_env(_TIER_LOCAL_RETENTION_ENV_VAR, 0.0))
+
+
+_KNOWN_COMPRESS_MODES = ("auto", "on", "off", "lz4")
+_warned_compress_modes: set = set()
+
+
+def get_compress_mode() -> str:
+    """Per-take fused tile compression (:mod:`tpusnap.compress`):
+
+    - ``auto`` (default) — a MEASURED per-take decision: compress when
+      the storage pipe's probe-reported ceiling is clearly slower than
+      the codec's measured throughput (cloud, virtio, the write-back
+      tier's remote drain), bypass when local disk outruns it. Takes
+      whose eligible payload is below the auto floor always bypass
+      (small takes are not worth the codec bookkeeping or a probe).
+    - ``on`` — compress every eligible blob regardless of the pipe.
+    - ``off`` — bypass entirely.
+    - ``lz4`` — force the named codec family (same as ``on`` today;
+      the name exists so a future codec can be pinned explicitly).
+
+    Unknown values warn once per process and fall back to ``auto``."""
+    raw = os.environ.get(_COMPRESS_ENV_VAR, "auto").strip().lower()
+    if raw not in _KNOWN_COMPRESS_MODES:
+        if raw not in _warned_compress_modes:
+            _warned_compress_modes.add(raw)
+            logger.warning(
+                "Ignoring unknown %s=%r (known: %s); using auto",
+                _COMPRESS_ENV_VAR,
+                raw,
+                ", ".join(_KNOWN_COMPRESS_MODES),
+            )
+        return "auto"
+    return raw
+
+
+def get_compress_min_blob_bytes() -> int:
+    """Per-blob eligibility floor for fused tile compression: blobs
+    smaller than this bypass the codec (slab members and tiny arrays
+    cost more in bookkeeping than the pipe saves). Floor of 64 KiB."""
+    return max(
+        64 * 1024, _get_int_env(_COMPRESS_MIN_BLOB_ENV_VAR, 1024 * 1024)
+    )
 
 
 def get_native_copy_threads() -> int:
@@ -823,6 +870,23 @@ def override_tier_outage(
                 _override_env(
                     _TIER_LOCAL_RETENTION_ENV_VAR, str(local_retention_s)
                 )
+            )
+        yield
+
+
+@contextlib.contextmanager
+def override_compress(
+    mode: Optional[str] = None,
+    min_blob_bytes: Optional[int] = None,
+) -> Generator[None, None, None]:
+    """Override the fused-compression policy knobs in one scope (None
+    leaves the corresponding env var untouched)."""
+    with contextlib.ExitStack() as stack:
+        if mode is not None:
+            stack.enter_context(_override_env(_COMPRESS_ENV_VAR, mode))
+        if min_blob_bytes is not None:
+            stack.enter_context(
+                _override_env(_COMPRESS_MIN_BLOB_ENV_VAR, str(min_blob_bytes))
             )
         yield
 

@@ -83,6 +83,29 @@ class TensorEntry(Entry):
     # tile-grain dedup decisions are equally strong.
     dedup_hash: Optional[str] = None
     tile_dedup_hashes: Optional[List[str]] = None
+    # Fused tile compression (tpusnap.compress). When ``codec`` is set
+    # the STORED blob is the concatenation of independently compressed
+    # checksum tiles: ``comp_tile_sizes[i]`` is tile i's stored size
+    # (a tile stored raw has size == its uncompressed tile size — the
+    # codec never stores a same-size compressed stream), tile i starts
+    # at sum(comp_tile_sizes[:i]) within the blob, and
+    # ``uncompressed_nbytes`` is the logical payload size. ALL recorded
+    # checksums/dedup hashes of a codec entry — ``checksum``,
+    # ``tile_checksums``, ``dedup_hash``, ``tile_dedup_hashes`` — are
+    # over the STORED (compressed) bytes, so the journal/salvage/
+    # upload-journal dual-hash evidence rule and scrub hold unchanged.
+    # Absent on uncompressed entries; old snapshots parse identically.
+    # ``uncompressed_dedup_hash`` (dedup-recording takes only) is the
+    # ONE exception to the stored-bytes rule: a dual hash
+    # ("<crc-algo>:<crc32>+xxh64:<xxh64>") of the RAW payload, recorded
+    # so the NEXT incremental take can prove an unchanged blob with a
+    # multi-GB/s hash pass instead of re-running the codec — the codec
+    # is deterministic, so equal raw bytes imply equal stored bytes.
+    # Never used to verify storage; purely write-skip evidence.
+    codec: Optional[str] = None
+    uncompressed_nbytes: Optional[int] = None
+    comp_tile_sizes: Optional[List[int]] = None
+    uncompressed_dedup_hash: Optional[str] = None
 
     def __init__(
         self,
@@ -97,6 +120,10 @@ class TensorEntry(Entry):
         tile_checksums: Optional[Sequence[str]] = None,
         dedup_hash: Optional[str] = None,
         tile_dedup_hashes: Optional[Sequence[str]] = None,
+        codec: Optional[str] = None,
+        uncompressed_nbytes: Optional[int] = None,
+        comp_tile_sizes: Optional[Sequence[int]] = None,
+        uncompressed_dedup_hash: Optional[str] = None,
     ) -> None:
         super().__init__(type="Tensor")
         self.location = location
@@ -114,6 +141,12 @@ class TensorEntry(Entry):
         self.tile_dedup_hashes = (
             list(tile_dedup_hashes) if tile_dedup_hashes is not None else None
         )
+        self.codec = codec
+        self.uncompressed_nbytes = uncompressed_nbytes
+        self.comp_tile_sizes = (
+            list(comp_tile_sizes) if comp_tile_sizes is not None else None
+        )
+        self.uncompressed_dedup_hash = uncompressed_dedup_hash
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "TensorEntry":
@@ -129,6 +162,10 @@ class TensorEntry(Entry):
             tile_checksums=d.get("tile_checksums"),
             dedup_hash=d.get("dedup_hash"),
             tile_dedup_hashes=d.get("tile_dedup_hashes"),
+            codec=d.get("codec"),
+            uncompressed_nbytes=d.get("uncompressed_nbytes"),
+            comp_tile_sizes=d.get("comp_tile_sizes"),
+            uncompressed_dedup_hash=d.get("uncompressed_dedup_hash"),
         )
 
 

@@ -90,6 +90,9 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
       one series per observed retry classification.
     - ``tpusnap_stall_episodes_total`` — stall-watchdog episodes.
     - ``tpusnap_salvage_bytes_total``, ``tpusnap_dedup_skips_total``.
+    - ``tpusnap_compress_bytes_in_total`` /
+      ``tpusnap_compress_bytes_out_total`` — fused tile codec volume
+      (ratio = in/out; equal ⇒ the auto policy is bypassing).
     - ``tpusnap_budget_high_water_bytes``,
       ``tpusnap_peak_rss_delta_bytes`` — gauges from the last summary.
     - ``tpusnap_storage_write_seconds`` /
@@ -272,6 +275,22 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
             "counter",
             "Incremental-dedup skipped blob writes.",
             [({}, counters.get("scheduler.dedup_skipped", 0))],
+        )
+        # Fused tile compression: input (logical) vs output (stored)
+        # bytes through the codec — the fleet-level compression ratio is
+        # rate(in)/rate(out), and a sustained in==out says the auto
+        # policy is bypassing (fast local disk) as designed.
+        metric(
+            "tpusnap_compress_bytes_in_total",
+            "counter",
+            "Logical bytes fed through the fused tile codec.",
+            [({}, counters.get("compress.bytes_in", 0))],
+        )
+        metric(
+            "tpusnap_compress_bytes_out_total",
+            "counter",
+            "Stored (compressed) bytes produced by the fused tile codec.",
+            [({}, counters.get("compress.bytes_out", 0))],
         )
         # Storage-boundary latency quantiles from the PROCESS-GLOBAL
         # log2 histograms (one summary-typed family per op, labeled by

@@ -274,6 +274,15 @@ class _ProbeRunner:
         self.ran = 0
         self._buf: Optional[memoryview] = None
         self._failed = False
+        from . import compress as _compress
+
+        try:
+            # Same device/bucket-scoped key the auto policy looks up —
+            # NOT the bare class label (two fs:// mounts with different
+            # bandwidth must not share a ceiling sample).
+            self._label = _compress.pipe_ceiling_key(storage)
+        except Exception:
+            self._label = ""
 
     @property
     def due(self) -> bool:
@@ -351,6 +360,12 @@ class _ProbeRunner:
             "elapsed_s": round(elapsed, 6),
         }
         self.ran += 1
+        # Feed the compression auto policy's ceiling registry: every
+        # probe sample keeps the pipe ceiling a live measurement, so
+        # the next take's compress-or-bypass decision is current.
+        from . import compress as _compress
+
+        _compress.note_pipe_ceiling(self._label, sample["write_gbps"])
         self.tele.add_probe_sample(sample)
         self.tele.record_span("probe_roofline", start, elapsed, **sample)
         telemetry.incr("probe.probes", rec=self.tele)

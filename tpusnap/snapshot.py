@@ -436,6 +436,7 @@ class Snapshot:
         _custom_array_prepare_func: Optional[Any] = None,
         _extras: Optional[Dict[str, Any]] = None,
         _record_dedup_hashes: bool = False,
+        _force_clone_staging: bool = False,
     ) -> "PendingSnapshot":
         comm = get_communicator(comm)
         event_loop = asyncio.new_event_loop()
@@ -464,6 +465,7 @@ class Snapshot:
                 abort_ctx=abort_ctx,
                 extras=_extras,
                 force_dedup_hashes=_record_dedup_hashes,
+                force_clone_staging=_force_clone_staging,
             )
             # Control returns to training here: the blocked window is
             # over — the first staging window is staged (ALL staging,
@@ -483,6 +485,7 @@ class Snapshot:
                 late_checksums=late_checksums,
                 abort_ctx=abort_ctx,
                 tele_commit=tele_commit,
+                force_clone_staging=_force_clone_staging,
             )
         except BaseException as e:
             telemetry.end_take(tele)
@@ -961,6 +964,7 @@ def _take_impl(
     abort_ctx: Optional["_TakeAbortContext"] = None,
     extras: Optional[Dict[str, Any]] = None,
     force_dedup_hashes: bool = False,
+    force_clone_staging: bool = False,
 ):
     """Core take flow. Exactly TWO all-gathers in the default
     multi-process path (the reference issues ~6 collectives,
@@ -1343,6 +1347,32 @@ def _take_impl(
     entries_list = list(entries.values())
     entries_list, write_reqs = batch_write_requests(entries_list, write_reqs)
     entries = dict(zip(entries.keys(), entries_list))
+
+    # Fused tile compression (tpusnap.compress): ONE measured
+    # compress-or-bypass decision per take — the codec's measured
+    # throughput against the probe-reported pipe ceiling — armed on the
+    # eligible stagers (standalone dense blobs after batching; slab
+    # members and shards bypass by construction). Never fails a take.
+    from . import compress as _compress
+
+    _compress.apply_take_policy(write_reqs, storage, event_loop, rec=mark.rec)
+    if force_clone_staging:
+        # Per-TAKE clone-staging override (delta micro-commits:
+        # free-running captures cannot rendezvous with the training
+        # thread, so COW's write-time mutation check would fail every
+        # commit). Armed on the stagers like the compress policy above
+        # — scoped to THIS take's requests, never a process-global env
+        # flip that would race concurrent takes on other threads.
+        # Batched slabs hold their members as (offset, nbytes, stager)
+        # tuples; the member stagers are the ones that consult COW.
+        def _arm_clone(st):
+            if hasattr(st, "force_clone"):
+                st.force_clone = True
+            for _m in getattr(st, "members", None) or []:
+                _arm_clone(_m[2] if isinstance(_m, tuple) else _m)
+
+        for _wr in write_reqs:
+            _arm_clone(_wr.buffer_stager)
     if abort_ctx is not None:
         # The final set of blob paths this rank may write — an aborting
         # take best-effort deletes them so the path stays reusable
@@ -1769,6 +1799,14 @@ class _LateChecksums:
                 # tile-grain dedup for the NEXT increment (the 64-bit
                 # evidence rule would force a whole-blob rewrite).
                 e.tile_dedup_hashes,
+                # Compressed-blob layout fields: a compressed stager
+                # annotates these at stage time (fused with the codec
+                # pass) but pipelines like any deferring stager, so
+                # they ride the same KV transport into every rank's
+                # by-value manifest copy.
+                e.codec,
+                e.uncompressed_nbytes,
+                e.comp_tile_sizes,
             )
         _get_kv_store(self.comm).set(
             self._key(self.comm.rank), pickle.dumps(fields)
@@ -1822,7 +1860,11 @@ class _LateChecksums:
                 for r in range(self.comm.world_size)
             }
         for raw in blobs.values():
-            for loc, (cs, tr, tcs, dh, tdh) in pickle.loads(raw).items():
+            for loc, fields in pickle.loads(raw).items():
+                cs, tr, tcs, dh, tdh = fields[:5]
+                codec, unb, cts = (
+                    fields[5:8] if len(fields) >= 8 else (None, None, None)
+                )
                 te = by_loc.get(loc)
                 if te is None:
                     continue  # e.g. an elastic reader's partial view
@@ -1834,6 +1876,10 @@ class _LateChecksums:
                     te.dedup_hash = dh
                 if te.tile_dedup_hashes is None:
                     te.tile_dedup_hashes = tdh
+                if te.codec is None and codec is not None:
+                    te.codec = codec
+                    te.uncompressed_nbytes = unb
+                    te.comp_tile_sizes = cts
 
     def cleanup(self) -> None:
         """Leader-only, strictly after the final commit barrier (every
@@ -2300,6 +2346,7 @@ class PendingSnapshot(_BackgroundWork):
         late_checksums: Optional["_LateChecksums"] = None,
         abort_ctx: Optional["_TakeAbortContext"] = None,
         tele_commit: Optional["_TelemetryCommit"] = None,
+        force_clone_staging: bool = False,
     ) -> None:
         self.path = path
         self._pending_io_work = pending_io_work
@@ -2315,9 +2362,13 @@ class PendingSnapshot(_BackgroundWork):
         # Captured at take time: under COW the staged() rendezvous must
         # report the SAFE-TO-MUTATE boundary (writes+verifies drained,
         # live bytes no longer read), not merely staging-complete.
+        # A force-clone take (delta micro-commits) staged real copies,
+        # so its rendezvous is the plain staging-complete boundary.
         from .knobs import is_async_cow_enabled
 
-        self._cow_rendezvous = is_async_cow_enabled()
+        self._cow_rendezvous = (
+            is_async_cow_enabled() and not force_clone_staging
+        )
 
         # Barrier identity must be agreed on the MAIN thread (this may
         # broadcast); the background thread then only touches the KV store.
