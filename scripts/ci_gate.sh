@@ -44,7 +44,15 @@
 #      like the timeline/slo/tiering smokes (SIGKILL-mid-compressed-
 #      take salvage lives in tier-1: tests/test_compress.py; the
 #      measured local-disk bypass claim lives in bench.py)
-#  10. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
+#  10. rank-failure smoke — a 2-process take whose rank 1 is SIGKILLed
+#      by a rank-scoped chaos plan (`rank=1,crash_after_op=write:1`)
+#      must fail on the survivor with RankFailedError naming the dead
+#      rank within seconds (lease liveness, not the 600 s barrier
+#      timeout); a second 2-process fully-replicated take under
+#      TPUSNAP_RANK_FAILURE=degrade must COMMIT on the survivor, scrub
+#      clean, restore bit-exact, and record the adoption in
+#      extras["degraded"]; hermetic like the other smokes
+#  11. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
 #      and/or `minio` binary is on PATH, run the `cloud_real` pytest
 #      marker against the real server processes (skipped silently
 #      when the binaries are absent)
@@ -66,14 +74,14 @@ cd "$(dirname "$0")/.."
 fail() { echo "ci_gate: FAIL — $1" >&2; exit "$2"; }
 
 # ---- 1. static analysis --------------------------------------------------
-echo "ci_gate: [1/10] lint --check (AST invariants)"
+echo "ci_gate: [1/11] lint --check (AST invariants)"
 env JAX_PLATFORMS=cpu python -m tpusnap lint --check
 rc=$?
 [ "$rc" -eq 0 ] || fail "tpusnap lint --check (rc=$rc)" "$rc"
 
 # ---- 2. tier-1 -----------------------------------------------------------
 if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
-    echo "ci_gate: [2/10] tier-1 tests"
+    echo "ci_gate: [2/11] tier-1 tests"
     rm -f /tmp/_t1.log
     # cloud_real excluded here: on a host with the server binaries the
     # real-backend suite belongs to step 8, not inside the fast tier.
@@ -84,11 +92,11 @@ if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
     echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
     [ "$rc" -eq 0 ] || fail "tier-1 tests (rc=$rc)" "$rc"
 else
-    echo "ci_gate: [2/10] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
+    echo "ci_gate: [2/11] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
 fi
 
 # ---- 3. cross-run history gate ------------------------------------------
-echo "ci_gate: [3/10] history --check (throughput + p99 write latency)"
+echo "ci_gate: [3/11] history --check (throughput + p99 write latency)"
 for kind in take bench; do
     python -m tpusnap history --check --kind "$kind" \
         --metric throughput_gbps --metric storage_write_p99_s --json
@@ -103,7 +111,7 @@ done
 # ---- 4. analyze doctor on the latest snapshot ---------------------------
 SNAP="${1:-${TPUSNAP_CI_SNAPSHOT:-}}"
 if [ -n "$SNAP" ]; then
-    echo "ci_gate: [4/10] analyze --check $SNAP"
+    echo "ci_gate: [4/11] analyze --check $SNAP"
     python -m tpusnap analyze --check --history "$SNAP"
     rc=$?
     case "$rc" in
@@ -112,11 +120,11 @@ if [ -n "$SNAP" ]; then
         *) fail "analyze --check $SNAP (rc=$rc)" "$rc" ;;
     esac
 else
-    echo "ci_gate: [4/10] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
+    echo "ci_gate: [4/11] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
 fi
 
 # ---- 5. checkpoint-SLO gate smoke ---------------------------------------
-echo "ci_gate: [5/10] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
+echo "ci_gate: [5/11] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, subprocess, sys, tempfile, time
 
@@ -173,7 +181,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "slo --check smoke (rc=$rc)" "$rc"
 
 # ---- 6. delta soak smoke -------------------------------------------------
-echo "ci_gate: [6/10] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
+echo "ci_gate: [6/11] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, re, shutil, signal, subprocess, sys, tempfile, time
 
@@ -317,7 +325,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "delta soak smoke (rc=$rc)" "$rc"
 
 # ---- 7. flight-recorder timeline smoke ----------------------------------
-echo "ci_gate: [7/10] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
+echo "ci_gate: [7/11] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, signal, subprocess, sys, tempfile
 
@@ -391,7 +399,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "timeline smoke (rc=$rc)" "$rc"
 
 # ---- 8. write-back tiering smoke ----------------------------------------
-echo "ci_gate: [8/10] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
+echo "ci_gate: [8/11] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, signal, subprocess, sys, tempfile
 
@@ -481,7 +489,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "tiering smoke (rc=$rc)" "$rc"
 
 # ---- 9. fused-compression smoke ------------------------------------------
-echo "ci_gate: [9/10] compression smoke (compressed take -> fsck/scrub clean -> bit-exact restore; auto bypasses locally, compresses on a throttled pipe)"
+echo "ci_gate: [9/11] compression smoke (compressed take -> fsck/scrub clean -> bit-exact restore; auto bypasses locally, compresses on a throttled pipe)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, sys, tempfile
 
@@ -591,9 +599,155 @@ PYEOF
 rc=$?
 [ "$rc" -eq 0 ] || fail "compression smoke (rc=$rc)" "$rc"
 
-# ---- 10. optional real-backend cloud suite -------------------------------
+# ---- 10. rank-failure smoke ----------------------------------------------
+echo "ci_gate: [10/11] rank-failure smoke (chaos rank-kill -> fast RankFailedError; degrade-mode replicated take -> committed + scrub clean)"
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import atexit, os, re, shutil, subprocess, sys, tempfile
+
+work = tempfile.mkdtemp(prefix="tpusnap_ci_rankfail_")
+atexit.register(shutil.rmtree, work, True)
+
+def die(msg):
+    print(f"rank-failure smoke: FAIL - {msg}", file=sys.stderr)
+    sys.exit(1)
+
+# The world script re-imported by run_subprocess_world's rank children
+# must live in an importable file (a heredoc has no module path).
+WORLD = r'''
+import os, signal, sys, time
+
+import numpy as np
+
+
+def _arrays(seed=5, n=4):
+    rng = np.random.default_rng(seed)
+    return {
+        f"w{i}": rng.standard_normal(16384).astype(np.float32)
+        for i in range(n)
+    }
+
+
+def world_fast_abort(snap_dir):
+    # Leg (a): TPUSNAP_FAULT_SPEC="rank=1,...,crash_after_op=write:1"
+    # SIGKILLs exactly rank 1 after its first chaos blob write; rank 0
+    # must fail fast with RankFailedError naming it — seconds, not the
+    # 600 s barrier timeout.
+    from tpusnap import RankFailedError, Snapshot, StateDict
+
+    state = {"m": StateDict(**_arrays())}
+    t0 = time.monotonic()
+    try:
+        Snapshot.take("chaos+fs://" + snap_dir, state, replicated=["**"])
+    except RankFailedError as e:
+        dt = time.monotonic() - t0
+        assert e.ranks == [1], e.ranks
+        assert dt <= 15.0, f"detection took {dt:.1f}s"
+        print(f"RANKFAILED dt={dt:.2f}", flush=True)
+        os._exit(0)  # skip the shutdown rendezvous with the dead peer
+    raise AssertionError("rank 0 never observed the rank failure")
+
+
+def world_degraded(snap_dir):
+    # Leg (b): TPUSNAP_RANK_FAILURE=degrade + a fully-replicated state:
+    # rank 1 dies mid-write, rank 0 completes the take, scrubs it
+    # clean, and the metadata records the adoption.
+    from tpusnap import Snapshot, StateDict, verify_snapshot
+    from tpusnap.comm import get_communicator
+
+    comm = get_communicator()
+    arrays = _arrays(seed=9)
+    if comm.rank == 1:
+        import tpusnap.storage_plugins.fs as fs_mod
+
+        orig = fs_mod.FSStoragePlugin.write
+        fired = [0]
+
+        async def hooked(self, write_io):
+            await orig(self, write_io)
+            if not write_io.path.startswith(".tpusnap"):
+                fired[0] += 1
+                if fired[0] == 1:
+                    os.kill(os.getpid(), signal.SIGKILL)
+
+        fs_mod.FSStoragePlugin.write = hooked
+    snap = Snapshot.take(snap_dir, {"m": StateDict(**arrays)}, replicated=["**"])
+    deg = (snap.metadata.extras or {}).get("degraded")
+    assert deg and deg["dead_ranks"] == [1], deg
+    rep = verify_snapshot(snap_dir)
+    assert rep.clean and not rep.corrupt, rep
+    tgt = {"m": StateDict(**{k: np.zeros_like(v) for k, v in arrays.items()})}
+    Snapshot(snap_dir).restore(tgt)
+    for k, v in arrays.items():
+        assert np.array_equal(tgt["m"][k], v), k
+    print("DEGRADED-COMMITTED", flush=True)
+    os._exit(0)  # skip the shutdown rendezvous with the dead peer
+
+
+if __name__ == "__main__":
+    from tpusnap.test_utils import run_subprocess_world
+
+    mode, snap = sys.argv[1], sys.argv[2]
+    env = {
+        "TPUSNAP_LIVENESS_TTL_S": "2.0",
+        "TPUSNAP_HEARTBEAT_INTERVAL_S": "0.1",
+        "TPUSNAP_DISABLE_BATCHING": "1",
+        "TPUSNAP_HISTORY": "0",
+        "TPUSNAP_TELEMETRY_DIR": os.path.join(os.path.dirname(snap), "tele"),
+    }
+    if mode == "abort":
+        env["TPUSNAP_FAULT_SPEC"] = (
+            "rank=1,transient_per_op=0,crash_after_op=write:1"
+        )
+    else:
+        env["TPUSNAP_RANK_FAILURE"] = "degrade"
+    fn = world_fast_abort if mode == "abort" else world_degraded
+    try:
+        run_subprocess_world(fn, world_size=2, args=[snap], extra_env=env,
+                             timeout=120)
+    except RuntimeError as e:
+        # Rank 1 died by design; rank 0's printed proof rides the logs.
+        print(str(e)[-4000:])
+'''
+world_py = os.path.join(work, "ci_rankfail_world.py")
+with open(world_py, "w") as f:
+    f.write(WORLD)
+
+# `python world.py` puts the script's own dir (not the repo root this
+# gate cd'd into) at sys.path[0] — hand the coordinator the package
+# explicitly; the rank children get it from run_subprocess_world.
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=os.getcwd(),
+           TPUSNAP_TELEMETRY_DIR=os.path.join(work, "tele"),
+           TPUSNAP_HISTORY="0")
+
+# (a) fast-abort exit contract.
+r = subprocess.run(
+    [sys.executable, world_py, "abort", os.path.join(work, "snap_abort")],
+    capture_output=True, text=True, env=env, timeout=300,
+)
+m = re.search(r"RANKFAILED dt=([0-9.]+)", r.stdout)
+if r.returncode != 0 or not m:
+    die(f"fast-abort leg rc={r.returncode}: {r.stdout[-1200:]}{r.stderr[-600:]}")
+dt = float(m.group(1))
+
+# (b) degrade-mode replicated take commits + scrubs clean.
+r = subprocess.run(
+    [sys.executable, world_py, "degrade", os.path.join(work, "snap_degrade")],
+    capture_output=True, text=True, env=env, timeout=300,
+)
+if r.returncode != 0 or "DEGRADED-COMMITTED" not in r.stdout:
+    die(f"degrade leg rc={r.returncode}: {r.stdout[-1200:]}{r.stderr[-600:]}")
+
+print(f"rank-failure smoke: OK (survivor detected the SIGKILLed rank in "
+      f"{dt:.1f}s; degraded replicated take committed, scrubbed clean, "
+      "restored bit-exact)")
+PYEOF
+rc=$?
+[ "$rc" -eq 0 ] || fail "rank-failure smoke (rc=$rc)" "$rc"
+
+# ---- 11. optional real-backend cloud suite -------------------------------
 if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&1; then
-    echo "ci_gate: [10/10] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
+    echo "ci_gate: [11/11] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m cloud_real \
         -p no:cacheprovider -p no:xdist -p no:randomly
     rc=$?
@@ -603,7 +757,7 @@ if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&
         fail "real-backend cloud suite (rc=$rc)" "$rc"
     fi
 else
-    echo "ci_gate: [10/10] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
+    echo "ci_gate: [11/11] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
 fi
 
 echo "ci_gate: PASS"

@@ -32,6 +32,7 @@ try:  # pragma: no cover - import surface grows as modules land
         Snapshot,
         load_snapshot,
     )
+    from .liveness import RankFailedError  # noqa: F401
     from .delta import (  # noqa: F401
         DeltaChainReport,
         DeltaStream,

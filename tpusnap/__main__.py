@@ -514,6 +514,33 @@ def cmd_fsck(args) -> int:
                 f"over {report.delta.get('parent')!r} — recovery lands on "
                 "the last committed increment (`fsck` the stream root)"
             )
+        # Rank-failure attribution: when the survivors' black boxes
+        # recorded a lease expiry, the torn verdict NAMES the dead
+        # rank(s) — "rank 2 died" beats "something tore" at 2 a.m.
+        try:
+            from .flight import load_flight_logs
+
+            logs = load_flight_logs(args.path, files=report.files)
+            take_id = report.journal.take_id
+            dead = sorted(
+                {
+                    e.get("rank")
+                    for doc in logs.values()
+                    if (doc.get("meta") or {}).get("take_id")
+                    in (None, take_id)
+                    for e in doc.get("events") or []
+                    if e.get("k") == "rank_dead"
+                    and isinstance(e.get("rank"), int)
+                }
+            )
+            if dead:
+                print(
+                    f"  dead rank(s) (lease expired): {dead} — the "
+                    "survivors observed the rank die; `tpusnap timeline` "
+                    "has the full post-mortem"
+                )
+        except Exception:
+            pass
     if args.verbose:
         for p in report.missing_referenced:
             print(f"MISSING  {p}")
@@ -1106,6 +1133,13 @@ def _render_verdict(verdict: dict) -> None:
             "flush, a non-local destination, or the host died with its "
             "telemetry dir"
         )
+    dead = verdict.get("dead_ranks")
+    if dead:
+        print(
+            f"  DEAD rank(s) {dead}: liveness lease expired — the "
+            "survivors observed these ranks die (SIGKILL/host loss), "
+            "which is why the take never committed"
+        )
     stalls = verdict.get("stall_episodes", 0)
     print(f"  stall episodes across ranks: {stalls}")
 
@@ -1493,7 +1527,7 @@ def cmd_slo(args) -> int:
         if report["ranks"]:
             print(
                 f"\n{'rank':>4} {'since-commit':>13} {'at-risk':>10} "
-                f"{'est-RTO':>9} {'rec-age':>8}  breach"
+                f"{'est-RTO':>9} {'rec-age':>8} {'dead':>6}  breach"
             )
             for r in report["ranks"]:
                 flags = [
@@ -1508,11 +1542,13 @@ def cmd_slo(args) -> int:
                     if r.get("committed")
                     else f"{_fmt_age(r['since_commit_s'])}*"
                 )
+                dead = r.get("dead_ranks")
+                dead_s = ",".join(str(d) for d in dead) if dead else "-"
                 print(
                     f"{r['rank']:>4} {since:>13} "
                     f"{_fmt_bytes(r['data_at_risk_bytes']):>10} "
                     f"{(_fmt_seconds(rto) if rto is not None else '-'):>9} "
-                    f"{_fmt_age(r['record_age_s']):>8}  "
+                    f"{_fmt_age(r['record_age_s']):>8} {dead_s:>6}  "
                     f"{','.join(flags) or '-'}"
                     + ("  (exited cleanly; exposure frozen)"
                        if r.get("final") else "")

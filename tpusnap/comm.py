@@ -46,7 +46,15 @@ from typing import Any, List, Optional
 
 logger = logging.getLogger(__name__)
 
-_DEFAULT_TIMEOUT_MS = 600_000  # mirrors reference dist_store.py:17 (600s)
+
+def _default_timeout_ms() -> int:
+    # Historically a 600_000 literal (mirroring reference
+    # dist_store.py:17); now routed through the one knob that bounds
+    # every blocking collective wait. Resolved per-instance so test
+    # overrides apply without reimports.
+    from .knobs import get_barrier_timeout_s
+
+    return int(get_barrier_timeout_s() * 1000.0)
 
 
 class Communicator:
@@ -125,7 +133,7 @@ class JaxCoordinationComm(Communicator):
 
     def __init__(
         self,
-        timeout_ms: int = _DEFAULT_TIMEOUT_MS,
+        timeout_ms: Optional[int] = None,
         namespace: Optional[str] = None,
     ) -> None:
         from jax._src import distributed
@@ -143,7 +151,9 @@ class JaxCoordinationComm(Communicator):
         # backend, which checkpointing of host state must never require.
         self._rank = distributed.global_state.process_id
         self._world_size = distributed.global_state.num_processes
-        self._timeout_ms = timeout_ms
+        self._timeout_ms = (
+            timeout_ms if timeout_ms is not None else _default_timeout_ms()
+        )
         # Keys are namespaced per instance so interleaved use of two
         # Communicator objects cannot cross-wire. Auto namespaces are
         # assigned LAZILY at the first collective — constructing a

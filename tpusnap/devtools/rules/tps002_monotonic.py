@@ -26,6 +26,7 @@ SCOPED_MODULES = {
     "history.py",
     "flight.py",
     "slo.py",
+    "liveness.py",
 }
 
 

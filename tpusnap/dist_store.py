@@ -28,8 +28,15 @@ from typing import Callable, List, Optional
 
 logger = logging.getLogger(__name__)
 
-_DEFAULT_TIMEOUT_SEC = 600.0
 _POLL_INTERVAL_SEC = 0.05
+
+
+def _default_timeout_sec() -> float:
+    # Historically a 600.0 literal; now the TPUSNAP_BARRIER_TIMEOUT_S
+    # knob, resolved per-wait so test overrides apply without reimports.
+    from .knobs import get_barrier_timeout_s
+
+    return get_barrier_timeout_s()
 
 
 class KVStore(abc.ABC):
@@ -49,7 +56,9 @@ class KVStore(abc.ABC):
     def delete_prefix(self, prefix: str) -> None:
         """Best-effort deletion of every key under ``prefix``."""
 
-    def get(self, key: str, timeout_sec: float = _DEFAULT_TIMEOUT_SEC) -> bytes:
+    def get(self, key: str, timeout_sec: Optional[float] = None) -> bytes:
+        if timeout_sec is None:
+            timeout_sec = _default_timeout_sec()
         deadline = time.monotonic() + timeout_sec
         while True:
             value = self.try_get(key)
@@ -354,7 +363,12 @@ class LinearBarrier:
     signals departure. ``report_error`` poisons the barrier: all waiters
     raise. ``watchers`` are callables run every poll iteration that may
     raise to abort the wait early (take-abort propagation). Pure KV
-    traffic — safe from non-main threads."""
+    traffic — safe from non-main threads.
+
+    ``ranks`` restricts membership to a subset of the world (default:
+    every rank) — the degraded-commit path synchronizes the SURVIVOR
+    set of a take whose dead rank will never arrive; the leader defaults
+    to the smallest member."""
 
     def __init__(
         self,
@@ -362,16 +376,29 @@ class LinearBarrier:
         prefix: str,
         rank: int,
         world_size: int,
-        leader_rank: int = 0,
-        timeout_sec: float = _DEFAULT_TIMEOUT_SEC,
+        leader_rank: Optional[int] = None,
+        timeout_sec: Optional[float] = None,
         watchers: Optional[List[Callable[[], None]]] = None,
+        ranks: Optional[List[int]] = None,
     ) -> None:
         self.store = store
         self.prefix = prefix
         self.rank = rank
         self.world_size = world_size
-        self.leader_rank = leader_rank
-        self.timeout_sec = timeout_sec
+        self.ranks = (
+            sorted(ranks) if ranks is not None else list(range(world_size))
+        )
+        if rank not in self.ranks:
+            raise ValueError(
+                f"LinearBarrier {prefix!r}: rank {rank} is not a member of "
+                f"{self.ranks}"
+            )
+        self.leader_rank = (
+            leader_rank if leader_rank is not None else min(self.ranks)
+        )
+        self.timeout_sec = (
+            timeout_sec if timeout_sec is not None else _default_timeout_sec()
+        )
         self.watchers = list(watchers or [])
         # True while blocked inside a _checked_get poll loop — read by
         # current_missing() from the stall watchdog thread.
@@ -392,7 +419,7 @@ class LinearBarrier:
             errs = None
         if errs is None:
             errs = {}
-            for r in range(self.world_size):
+            for r in self.ranks:
                 err = self.store.try_get(self._key("error", str(r)))
                 if err is not None:
                     errs[str(r)] = err
@@ -432,7 +459,7 @@ class LinearBarrier:
         if not self._in_wait:
             return None
         missing = []
-        for r in range(self.world_size):
+        for r in self.ranks:
             try:
                 if self.store.try_get(self._key("arrive", str(r))) is None:
                     missing.append(r)
@@ -449,7 +476,7 @@ class LinearBarrier:
         with telemetry.span("kv.barrier_arrive"):
             self.store.set(self._key("arrive", str(self.rank)), b"1")
             if self.rank == self.leader_rank:
-                for r in range(self.world_size):
+                for r in self.ranks:
                     self._checked_get(self._key("arrive", str(r)))
 
     def depart(self) -> None:

@@ -96,6 +96,22 @@ class FaultPlan:
       bandwidth-bound regime the compression auto policy exists for;
       bench.py's compression section and ci_gate's compression smoke
       run on it.
+    - ``rank``: RANK FILTER — the whole plan applies only on the
+      process whose distributed rank (jax.distributed process_id, 0
+      when uninitialized) matches; every other rank's plugin behaves
+      fault-free. One shared ``TPUSNAP_FAULT_SPEC`` can thus
+      deterministically kill or wedge exactly one rank of a
+      multi-process world (``rank=1,crash_after_op=write:2``) — the
+      rank-failure crash matrix and ci_gate's rank-failure smoke run
+      on it.
+    - ``wedge``: ("write", 3) → the 3rd write ATTEMPT SIGSTOPs the
+      whole process (index 0/``*`` = first attempt of the kind). Unlike
+      ``stall_op`` — which hangs one op while heartbeat/lease threads
+      keep running (a SLOW rank) — SIGSTOP freezes every thread, so
+      from the peers' view the rank is DEAD (leases expire, liveness
+      raises RankFailedError) while the parent test can still SIGCONT
+      or SIGKILL the frozen process. The deterministic "host froze"
+      fault the lease layer exists for.
     """
 
     seed: int = 0
@@ -108,6 +124,8 @@ class FaultPlan:
     stall_op: Optional[Tuple[str, int, float]] = None
     outage: Optional[Tuple[str, float, float]] = None
     bandwidth_gbps: float = 0.0
+    rank: Optional[int] = None
+    wedge: Optional[Tuple[str, int]] = None
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
@@ -132,9 +150,16 @@ class FaultPlan:
                 setattr(plan, key, int(value))
             elif key in ("torn_writes", "short_reads"):
                 setattr(plan, key, value not in ("0", "false", "False", ""))
+            elif key == "rank":
+                plan.rank = int(value)
             elif key == "crash_after_op":
                 kind, _, idx = value.partition(":")
                 plan.crash_after_op = (kind, int(idx))
+            elif key == "wedge":
+                # "write:3" → 3rd write attempt SIGSTOPs the process
+                # ("write:*" or index 0 → the first attempt).
+                kind, _, idx = value.partition(":")
+                plan.wedge = (kind, 0 if idx in ("", "*") else int(idx))
             elif key == "stall_op":
                 # "write:3:5.0" → 3rd write attempt sleeps 5 s
                 # ("write:*:5.0" or index 0 → every attempt).
@@ -189,6 +214,7 @@ class _FaultState:
     op_count: int = 0
     kind_success: Dict[str, int] = field(default_factory=dict)
     kind_attempts: Dict[str, int] = field(default_factory=dict)
+    wedge_attempts: Dict[str, int] = field(default_factory=dict)
     per_op_attempts: Dict[Tuple[str, str], int] = field(default_factory=dict)
     lock: threading.Lock = field(default_factory=threading.Lock)
     # Outage-window anchor (monotonic, set at this plugin's first op)
@@ -205,6 +231,18 @@ class _FaultState:
 _mono = time.monotonic
 
 
+def _process_rank() -> int:
+    """This process's distributed rank for the ``rank=`` plan filter —
+    jax.distributed's coordination state (the same source comm.py
+    reads; never initializes a device backend), 0 when uninitialized."""
+    try:
+        from jax._src import distributed as _jd
+
+        return int(_jd.global_state.process_id or 0)
+    except Exception:
+        return 0
+
+
 class FaultInjectionStoragePlugin(StoragePlugin):
     """Wraps any ``StoragePlugin``, misbehaving per a seeded ``FaultPlan``.
     Scheduling-transparent like the retry wrapper (in-place reads,
@@ -213,6 +251,11 @@ class FaultInjectionStoragePlugin(StoragePlugin):
     def __init__(self, inner: StoragePlugin, plan: Optional[FaultPlan] = None):
         self.inner = inner
         self.plan = FaultPlan.coerce(plan)
+        if self.plan.rank is not None and self.plan.rank != _process_rank():
+            # Rank-filtered plan on a non-matching rank: behave
+            # fault-free (an inert plan, not a bypassed wrapper, so the
+            # plugin surface stays identical on every rank).
+            self.plan = FaultPlan(seed=self.plan.seed)
         self._state = _FaultState(rng=random.Random(self.plan.seed))
 
     # --- scheduling transparency -----------------------------------------
@@ -374,10 +417,40 @@ class FaultInjectionStoragePlugin(StoragePlugin):
             telemetry.incr("faults.bandwidth_throttled")
             await asyncio.sleep(delay)
 
+    def _check_wedge(self, kind: str) -> None:
+        """SIGSTOP this process on the planned attempt of ``kind``: the
+        whole process freezes (heartbeat pump and lease publisher
+        included), so peers' liveness leases expire and survivors raise
+        RankFailedError — a dead rank from their view, while the parent
+        test keeps a SIGCONT/SIGKILL handle on the frozen pid."""
+        plan, st = self.plan, self._state
+        if plan.wedge is None or plan.wedge[0] != kind:
+            return
+        with st.lock:
+            n = st.wedge_attempts.get(kind, 0) + 1
+            st.wedge_attempts[kind] = n
+        idx = plan.wedge[1]
+        if idx != 0 and n != idx:
+            return
+        telemetry.incr(f"faults.wedged.{kind}")
+        flight.record("fault_wedge", op=kind)
+        # Flush the black box NOW: a frozen process never reaches its
+        # next heartbeat flush, and the wedge breadcrumb is exactly
+        # what the post-mortem needs.
+        try:
+            flight.recorder().maybe_flush(force=True)
+        except Exception:
+            logger.debug("pre-wedge flight flush failed", exc_info=True)
+        logger.warning(
+            "FaultPlan wedge=%s: SIGSTOPping pid %d", plan.wedge, os.getpid()
+        )
+        os.kill(os.getpid(), signal.SIGSTOP)
+
     async def _pre(self, kind: str, path: str) -> bool:
         """Apply latency + injected stalls; return whether this attempt
         must fail."""
         self._check_outage(kind, path)
+        self._check_wedge(kind)
         inject, latency = self._decide(kind, path)
         if latency:
             telemetry.incr("faults.latency_injections")

@@ -782,6 +782,17 @@ def postmortem_verdict(
             "stall_episodes": sum(
                 1 for e in events if e.get("k") == "stall"
             ),
+            # Peers THIS rank's liveness monitor declared dead (lease
+            # expired) — the black box's dead-vs-slow distinction.
+            "dead_ranks_seen": sorted(
+                {
+                    e.get("rank")
+                    for e in events
+                    if e.get("k") == "rank_dead"
+                    and isinstance(e.get("rank"), int)
+                }
+            )
+            or None,
             "events": len(events),
             "dropped": meta.get("dropped", 0),
             "take_id": meta.get("take_id"),
@@ -790,12 +801,19 @@ def postmortem_verdict(
             r["journal"] = journal_evidence[rank]
         ranks[rank] = r
     missing = sorted(set(range(world_size)) - set(logs))
+    # The union of every survivor's lease-expiry observations: the
+    # ranks the take DIED on, as opposed to ranks whose log merely
+    # never flushed (missing_ranks covers those too).
+    dead: set = set()
+    for r in ranks.values():
+        dead.update(r.get("dead_ranks_seen") or ())
     return {
         "path": path,
         "state": state,
         "world_size": world_size,
         "ranks": ranks,
         "missing_ranks": missing,
+        "dead_ranks": sorted(dead),
         "stall_episodes": sum(
             r["stall_episodes"] for r in ranks.values()
         ),

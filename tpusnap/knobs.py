@@ -60,6 +60,9 @@ _TIER_BACKOFF_CAP_ENV_VAR = "TPUSNAP_TIER_BACKOFF_CAP_S"
 _TIER_LOCAL_RETENTION_ENV_VAR = "TPUSNAP_TIER_LOCAL_RETENTION_S"
 _COMPRESS_ENV_VAR = "TPUSNAP_COMPRESS"
 _COMPRESS_MIN_BLOB_ENV_VAR = "TPUSNAP_COMPRESS_MIN_BLOB_BYTES"
+_BARRIER_TIMEOUT_ENV_VAR = "TPUSNAP_BARRIER_TIMEOUT_S"
+_LIVENESS_TTL_ENV_VAR = "TPUSNAP_LIVENESS_TTL_S"
+_RANK_FAILURE_ENV_VAR = "TPUSNAP_RANK_FAILURE"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -590,6 +593,83 @@ def get_compress_min_blob_bytes() -> int:
     )
 
 
+def get_barrier_timeout_s() -> float:
+    """Hard deadline of every blocking collective/KV wait (the
+    coordination-service barriers in :mod:`tpusnap.comm`, the
+    ``LinearBarrier``/``KVStore.get`` polls in :mod:`tpusnap.dist_store`).
+    Historically three separate literals (600 s in comm/dist_store,
+    1800 s on the async commit barrier — see
+    :func:`get_commit_barrier_timeout_s`); one knob now routes them all.
+    This is the LAST-RESORT bound: with liveness leases on
+    (``TPUSNAP_LIVENESS_TTL_S``) a dead peer fails the wait within
+    ~2x the lease TTL, so the full timeout is only burned when the
+    coordination service itself is unreachable. Floor of 1 s."""
+    return max(1.0, _get_float_env(_BARRIER_TIMEOUT_ENV_VAR, 600.0))
+
+
+def get_commit_barrier_timeout_s() -> float:
+    """Deadline of the async commit's LinearBarrier waits — 3x the
+    collective timeout, preserving the historical 600 s/1800 s ratio
+    (the commit barrier waits on every rank's full residual I/O drain,
+    not just a collective round-trip)."""
+    return 3.0 * get_barrier_timeout_s()
+
+
+def get_liveness_ttl_s() -> float:
+    """Rank-liveness lease TTL (:mod:`tpusnap.liveness`): each rank's
+    lease record (published over the coordination KV by the heartbeat
+    pump — no extra thread) must advance within this window or peers
+    blocked in a collective/commit wait declare the rank dead and raise
+    :class:`~tpusnap.liveness.RankFailedError` naming it, within ~2x
+    this TTL instead of parking until the barrier timeout. ``0``
+    disables the liveness layer (waits fall back to the bare
+    ``TPUSNAP_BARRIER_TIMEOUT_S``). Requires telemetry (the lease rides
+    the heartbeat pump); keep the value well above the heartbeat
+    interval — the floor is 4x ``TPUSNAP_HEARTBEAT_INTERVAL_S``."""
+    ttl = _get_float_env(_LIVENESS_TTL_ENV_VAR, 15.0)
+    if ttl <= 0:
+        return 0.0
+    return max(4.0 * get_heartbeat_interval_s(), ttl)
+
+
+_KNOWN_RANK_FAILURE_POLICIES = ("abort", "degrade")
+_warned_rank_failure_policies: set = set()
+
+
+def get_rank_failure_policy() -> str:
+    """What a multi-process take does when liveness declares a peer
+    dead mid-take:
+
+    - ``abort`` (default) — the detecting rank raises
+      :class:`~tpusnap.liveness.RankFailedError`, publishes it through
+      the take-abort monitor so every survivor aborts within seconds,
+      and the path is left torn (fsck/`timeline` name the dead rank; a
+      retake salvages the survivors' completed blobs via the dual-hash
+      evidence rule).
+    - ``degrade`` — a take whose dead rank held only REPLICATED
+      partitions is completed by the survivors: the dead rank's
+      replicated write assignments are adopted by live ranks
+      (re-planned deterministically), the commit barrier shrinks to the
+      live set, and ``metadata.extras["degraded"]`` records the
+      adoption. A dead rank holding sharded/unique partitions (or an
+      incremental take) still aborts — its bytes are unrecoverable.
+
+    Must be set identically on every rank. Unknown values warn once per
+    process and fall back to ``abort``."""
+    raw = os.environ.get(_RANK_FAILURE_ENV_VAR, "abort").strip().lower()
+    if raw not in _KNOWN_RANK_FAILURE_POLICIES:
+        if raw not in _warned_rank_failure_policies:
+            _warned_rank_failure_policies.add(raw)
+            logger.warning(
+                "Ignoring unknown %s=%r (known: %s); using abort",
+                _RANK_FAILURE_ENV_VAR,
+                raw,
+                ", ".join(_KNOWN_RANK_FAILURE_POLICIES),
+            )
+        return "abort"
+    return raw
+
+
 def get_native_copy_threads() -> int:
     """Internal threads of ONE native copy/hash pass (``_native.memcpy``
     and the fused clone+CRC(+XXH64) tile passes), derived so the TOTAL
@@ -888,6 +968,29 @@ def override_compress(
             stack.enter_context(
                 _override_env(_COMPRESS_MIN_BLOB_ENV_VAR, str(min_blob_bytes))
             )
+        yield
+
+
+@contextlib.contextmanager
+def override_barrier_timeout_s(seconds: float) -> Generator[None, None, None]:
+    with _override_env(_BARRIER_TIMEOUT_ENV_VAR, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def override_liveness(
+    ttl_s: Optional[float] = None,
+    policy: Optional[str] = None,
+) -> Generator[None, None, None]:
+    """Override the rank-liveness knobs in one scope (None leaves the
+    corresponding env var untouched)."""
+    with contextlib.ExitStack() as stack:
+        if ttl_s is not None:
+            stack.enter_context(
+                _override_env(_LIVENESS_TTL_ENV_VAR, str(ttl_s))
+            )
+        if policy is not None:
+            stack.enter_context(_override_env(_RANK_FAILURE_ENV_VAR, policy))
         yield
 
 

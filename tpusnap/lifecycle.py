@@ -561,6 +561,15 @@ class FsckReport:
                     else ", orphan scan unsupported on this backend"
                 )
             )
+            deg = (getattr(self.metadata, "extras", None) or {}).get(
+                "degraded"
+            )
+            if isinstance(deg, dict) and deg.get("dead_ranks"):
+                s += (
+                    f" [DEGRADED commit: rank(s) {deg['dead_ranks']} died "
+                    "mid-take; their replicated writes were adopted by "
+                    "the survivors]"
+                )
             if self.durability is not None:
                 s += f" [{self.durability}"
                 if self.durability == "local-committed" and self.tier_remote:

@@ -190,16 +190,25 @@ def filter_assigned_write_reqs(
     replicated_paths: List[str],
     assignment: Dict[str, int],
     rank: int,
-) -> List[WriteReq]:
+) -> Tuple[List[WriteReq], Dict[str, List[WriteReq]]]:
     """Drop replicated write requests not assigned to this rank. Entries
-    are left untouched (locations are rank-agnostic)."""
+    are left untouched (locations are rank-agnostic).
+
+    Returns ``(kept, dropped)`` where ``dropped`` maps each
+    partitioned unit id assigned to ANOTHER rank to this rank's own
+    (identical-bytes, unstaged) write requests for it — retained so the
+    degraded-commit path can ADOPT a dead rank's assignments: any
+    survivor can stage and write its own replicated copy in the dead
+    writer's place (snapshot.py's ``_degraded_commit``)."""
     if not replicated_paths or is_partitioner_disabled():
-        return write_reqs
+        return write_reqs, {}
     keep_paths = set()
     replicated_req_paths = set()
+    unit_of_location: Dict[str, str] = {}
 
     def decide(unit_id: str, location: str) -> None:
         replicated_req_paths.add(location)
+        unit_of_location[location] = unit_id
         writer = assignment.get(unit_id)
         if writer is None:
             # A unit of a replicated-marked path missing from the plan
@@ -226,11 +235,30 @@ def filter_assigned_write_reqs(
             loc = getattr(entry, "location", None)
             if loc is not None:
                 decide(logical_path, loc)
-    return [
-        wr
-        for wr in write_reqs
-        if wr.path not in replicated_req_paths or wr.path in keep_paths
-    ]
+    kept = []
+    dropped: Dict[str, List[WriteReq]] = {}
+    for wr in write_reqs:
+        if wr.path not in replicated_req_paths or wr.path in keep_paths:
+            kept.append(wr)
+        else:
+            dropped.setdefault(unit_of_location[wr.path], []).append(wr)
+    return kept, dropped
+
+
+def reassign_dead_units(
+    assignment: Dict[str, int],
+    dead_ranks,
+    live_ranks,
+) -> Dict[str, int]:
+    """Deterministic adoption plan for a degraded commit: every unit
+    whose assigned writer died is re-assigned round-robin across the
+    sorted live set. Every survivor computes the identical plan from
+    the identical (assignment, dead, live) inputs — the same
+    no-broadcast property as the original argmin-greedy."""
+    dead = set(dead_ranks)
+    live = sorted(live_ranks)
+    orphaned = sorted(u for u, w in assignment.items() if w in dead)
+    return {u: live[i % len(live)] for i, u in enumerate(orphaned)}
 
 
 

@@ -192,6 +192,12 @@ class ProgressMonitor:
         self._slo_provider: Optional[Callable[[], Optional[Dict[str, Any]]]] = (
             None
         )
+        # Liveness probe (tpusnap.liveness): "which peer ranks' leases
+        # have expired?" — folded into stall episodes (dead vs slow
+        # rank) and the published heartbeat record's dead_ranks field.
+        self._liveness_probe: Optional[
+            Callable[[], Optional[List[int]]]
+        ] = None
         self._clock = clock
         self._wall = wall_clock
         self._state = "running"
@@ -241,6 +247,21 @@ class ProgressMonitor:
         Exceptions are swallowed — exposure accounting must never fail
         a heartbeat."""
         self._slo_provider = fn
+
+    def set_liveness_probe(
+        self, fn: Callable[[], Optional[List[int]]]
+    ) -> None:
+        """Register the lease-staleness probe (see ``_liveness_probe``).
+        Best-effort like every observability hook."""
+        self._liveness_probe = fn
+
+    def _probe_dead_ranks(self) -> Optional[List[int]]:
+        if self._liveness_probe is None:
+            return None
+        try:
+            return self._liveness_probe()
+        except Exception:
+            return None
 
     # --- the pump -------------------------------------------------------
 
@@ -313,6 +334,10 @@ class ProgressMonitor:
             telemetry.incr("progress.stall_episodes", rec=self.tele)
         except Exception:
             pass
+        # Lease staleness splits the episode verdict: a stall with a
+        # DEAD peer is a rank failure in progress (liveness will fail
+        # the wait within ~2xTTL), not merely a slow rank.
+        dead = self._probe_dead_ranks()
         info = {
             "rank": self.rank,
             "take_id": self.take_id,
@@ -321,6 +346,7 @@ class ProgressMonitor:
             "phase": snap["phase"],
             "stalled_s": round(stalled_s, 1),
             "missing_ranks": missing,
+            "dead_ranks": dead,
         }
         try:
             from . import flight
@@ -331,12 +357,13 @@ class ProgressMonitor:
                 stalled_s=round(stalled_s, 1),
                 phase=snap["phase"],
                 missing_ranks=missing,
+                dead_ranks=dead,
             )
         except Exception:
             logger.debug("flight stall record failed", exc_info=True)
         logger.warning(
             "tpusnap stall: rank %d made no forward progress for %.1fs "
-            "inside op %r (last completed phase %r)%s",
+            "inside op %r (last completed phase %r)%s%s",
             self.rank,
             stalled_s,
             op,
@@ -345,6 +372,11 @@ class ProgressMonitor:
                 f"; ranks not arrived: {missing}"
                 if missing
                 else "; no barrier attribution available"
+            ),
+            (
+                f"; DEAD rank(s) (lease expired): {dead}"
+                if dead
+                else ""
             ),
             extra={"tpusnap_stall": info},
         )
@@ -441,6 +473,12 @@ class ProgressMonitor:
         # achievable instead of a bare number.
         if snap.get("probe_write_gbps"):
             rec["probe_write_gbps"] = snap["probe_write_gbps"]
+        # Peer ranks this rank's liveness monitor has declared dead —
+        # `watch` flags them so an operator sees "rank 2 died" on the
+        # survivors' rows, not just a stalled percentage.
+        dead = self._probe_dead_ranks()
+        if dead:
+            rec["dead_ranks"] = dead
         if self._slo_provider is not None:
             try:
                 slo = self._slo_provider()
@@ -605,6 +643,9 @@ def render_watch_table(
         flag = ""
         if r.get("state") == "running" and age > stall_flag_s:
             flag = "  ** STALLED?"
+        dead = r.get("dead_ranks")
+        if dead:
+            flag = f"  ** PEER DEAD {dead}" + flag
         # With in-take probes on, express live MB/s against the latest
         # self-measured ceiling — "600 MB/s (31% of ceiling)" answers
         # "is that slow?" without leaving the table.

@@ -313,6 +313,11 @@ class SLOTracker:
         self._last_sidecar_t: Optional[float] = None
         self._publish_lock = threading.Lock()
         self._fleet: Optional[Dict[str, Any]] = None
+        # Peer ranks declared dead by the liveness layer during the
+        # current take (tpusnap.liveness.LivenessMonitor feeds this);
+        # cleared when a take commits or aborts — a dead peer from a
+        # finished take is history, not live exposure.
+        self._dead_ranks: set = set()
 
     # --- inputs ---------------------------------------------------------
 
@@ -320,6 +325,12 @@ class SLOTracker:
         with self._lock:
             self.rank = rank
             self.world_size = world_size
+
+    def note_rank_dead(self, ranks) -> None:
+        """Liveness feed: ``ranks``' leases expired during the current
+        take. Rides the sidecar/gauges until the take settles."""
+        with self._lock:
+            self._dead_ranks.update(int(r) for r in ranks)
 
     def record_step(self, bytes_changed: int) -> None:
         """Training-loop API: declare that ``bytes_changed`` bytes of
@@ -399,6 +410,7 @@ class SLOTracker:
             # overlapping take had overwritten the slot, its commit
             # merely falls back to commit-time anchoring: conservative.
             self._capture = None
+            self._dead_ranks.clear()
 
     def record_commit(
         self,
@@ -478,6 +490,7 @@ class SLOTracker:
                     self._planned_incremental = False
                     self._live_counters = None
             self._fleet = None
+            self._dead_ranks.clear()
         self.refresh_rto()
         section = {
             "commit_interval_s": round(interval, 3),
@@ -626,6 +639,11 @@ class SLOTracker:
                 "rto_read_gbps": rto.read_gbps if rto.ok else None,
                 "rto_n_baseline": rto.n_baseline,
                 "stream_cadence_s": self._stream_cadence_s,
+                # Peer ranks the liveness layer declared dead during
+                # the current take (tpusnap.liveness) — the slo CLI's
+                # `dead` column: an RPO breach with a dead peer is a
+                # rank failure, not a slow checkpoint cadence.
+                "dead_ranks": sorted(self._dead_ranks) or None,
                 "thresholds": {
                     "rpo_s": rpo_thresh or None,
                     "rto_s": rto_thresh or None,
@@ -951,6 +969,10 @@ def evaluate_records(
             # (tpusnap.delta) — the bound a healthy stream keeps
             # since_commit under; None when no stream was active.
             "stream_cadence_s": rec.get("stream_cadence_s"),
+            # Peer ranks this rank's liveness layer declared dead
+            # during its current take — exposure with a dead peer is a
+            # rank failure, not a slow cadence.
+            "dead_ranks": rec.get("dead_ranks"),
         }
         row["breach_rpo"] = bool(
             rpo_threshold_s and since_commit > rpo_threshold_s

@@ -60,6 +60,11 @@ from .dist_store import (
 )
 from .flatten import flatten, inflate
 from .io_preparer import prepare_read, prepare_write
+from .liveness import (
+    LeasePublisher,
+    LivenessMonitor,
+    RankFailedError,
+)
 from .io_types import ReadIO, StoragePlugin, WriteIO
 from .manifest import (
     Entry,
@@ -400,7 +405,41 @@ class Snapshot:
                     )
                 if abort_ctx.monitor is not None:
                     abort_ctx.monitor.clear()
+                if abort_ctx.lease is not None:
+                    abort_ctx.lease.cleanup()
             storage.sync_close(event_loop)
+        except RankFailedError as rank_exc:
+            # A peer died mid-take. Under TPUSNAP_RANK_FAILURE=degrade
+            # (and with the recovery context armed — post-staging,
+            # non-incremental), the survivors complete a
+            # replicated-only take without it; anything else aborts to
+            # a torn state exactly like any failure, with the dead
+            # rank named by the flight breadcrumbs.
+            try:
+                degraded_meta = _maybe_degraded_commit(abort_ctx, rank_exc)
+            except BaseException as e:
+                abort_ctx.on_failure(e)
+                raise
+            if degraded_meta is None:
+                abort_ctx.on_failure(rank_exc)
+                raise
+            metadata = degraded_meta
+            meta_cached = True  # every survivor built the same manifest
+            tele.meta["completed"] = True
+            _record_slo_commit(
+                tele, metadata, abort_ctx.degrade.take_id, path, comm.rank
+            )
+            if abort_ctx.progress is not None:
+                try:
+                    abort_ctx.progress.finish("committed")
+                except Exception:
+                    pass
+            from . import flight as _flight_mod
+
+            _flight_mod.recorder().end_take("committed")
+            abort_ctx.degrade.storage.sync_close(
+                abort_ctx.degrade.event_loop
+            )
         except BaseException as e:
             abort_ctx.on_failure(e)
             raise
@@ -825,6 +864,13 @@ class _TakeAbortContext:
         # Heartbeat/watchdog monitor (tpusnap.progress) — stopped with
         # a final "aborted" record on any failure path.
         self.progress = None
+        # Rank-liveness layer (tpusnap.liveness): the lease this rank
+        # publishes, the monitor every blocking wait consults, and —
+        # when the failure policy is `degrade` — the context the
+        # survivors complete a replicated-only take from.
+        self.lease: Optional[LeasePublisher] = None
+        self.liveness: Optional[LivenessMonitor] = None
+        self.degrade: Optional["_DegradeContext"] = None
         self.commit_started = False
         # Set once the take's journal exists: an ABORTED take (as opposed
         # to a SIGKILLed one) cleans its blobs, so it also clears the
@@ -833,7 +879,42 @@ class _TakeAbortContext:
 
     def arm(self, monitor: TakeAbortMonitor) -> None:
         self.monitor = monitor
-        self.comm.set_wait_watcher(monitor.check)
+        self._install_watcher()
+
+    def arm_liveness(
+        self, lease: LeasePublisher, liveness: LivenessMonitor
+    ) -> None:
+        """Installed once the heartbeat pump exists (strictly after
+        ``arm``): the combined wait watcher now also judges lease
+        staleness, so a blocked collective raises RankFailedError
+        within ~2x TTL of a peer's death."""
+        self.lease = lease
+        self.liveness = liveness
+        self._install_watcher()
+
+    def _install_watcher(self) -> None:
+        monitor, liveness = self.monitor, self.liveness
+        if monitor is None:
+            return
+        if liveness is None:
+            self.comm.set_wait_watcher(monitor.check)
+            return
+
+        def watcher() -> None:
+            monitor.check()
+            liveness.check()
+
+        self.comm.set_wait_watcher(watcher)
+
+    def barrier_watchers(self) -> List:
+        """Watcher list for LinearBarrier-based waits (the async
+        commit): peer-abort records AND lease expiry."""
+        out = []
+        if self.monitor is not None:
+            out.append(self.monitor.check)
+        if self.liveness is not None:
+            out.append(self.liveness.check)
+        return out
 
     def disarm(self) -> None:
         if self.monitor is not None:
@@ -873,8 +954,22 @@ class _TakeAbortContext:
             pass
         if self.monitor is not None and not isinstance(exc, TakeAbortedError):
             self.monitor.publish(exc)
-        keep_blobs = self.commit_started or (
-            self.monitor is not None and self.monitor.commit_may_have_started()
+        # A RANK-FAILURE abort keeps everything: the survivors' completed
+        # blobs are good bytes and the journal records are their salvage
+        # evidence — deleting them would reduce the retake to byte zero,
+        # and the dead rank cannot clean its own either way. The torn
+        # state it leaves is exactly what fsck/timeline classify (naming
+        # the dead rank) and what the retake's dual-hash salvage reuses.
+        rank_failure = isinstance(exc, RankFailedError) or isinstance(
+            getattr(exc, "__cause__", None), RankFailedError
+        )
+        keep_blobs = (
+            self.commit_started
+            or rank_failure
+            or (
+                self.monitor is not None
+                and self.monitor.commit_may_have_started()
+            )
         )
         if (
             not keep_blobs
@@ -1257,6 +1352,43 @@ def _take_impl(
         logger.warning(
             "Failed to configure flight recorder (non-fatal)", exc_info=True
         )
+    # Rank-liveness leases (tpusnap.liveness): this rank's lease rides
+    # the heartbeat pump (no new thread) and the monitor joins every
+    # blocking wait's watcher, so a SIGKILLed peer fails the take with
+    # RankFailedError within ~2x TPUSNAP_LIVENESS_TTL_S instead of
+    # parking until the barrier timeout. Requires the pump (telemetry
+    # on — SPMD-identical on every rank) and a coordination KV.
+    if (
+        multi
+        and abort_ctx is not None
+        and progress_monitor is not None
+        and progress_monitor.kv is not None
+    ):
+        from .knobs import get_liveness_ttl_s
+
+        ttl = get_liveness_ttl_s()
+        if ttl > 0:
+            try:
+                lease = LeasePublisher(progress_monitor.kv, take_id, rank)
+                lease.publish()  # alive NOW, not one pump tick later
+                liveness_monitor = LivenessMonitor(
+                    progress_monitor.kv,
+                    take_id,
+                    rank,
+                    comm.world_size,
+                    ttl_s=ttl,
+                )
+                progress_monitor.add_tick_hook(lease.make_tick_hook())
+                progress_monitor.set_liveness_probe(
+                    liveness_monitor.dead_ranks
+                )
+                abort_ctx.arm_liveness(lease, liveness_monitor)
+            except Exception:
+                logger.warning(
+                    "Failed to arm rank-liveness leases (non-fatal)",
+                    exc_info=True,
+                )
+
     # Checkpoint-SLO tracker (tpusnap.slo): the exposure gauges (RPO,
     # data-at-risk, estimated RTO) publish at the heartbeat cadence on
     # the same pump thread, and the slo sub-dict rides every heartbeat
@@ -1334,10 +1466,11 @@ def _take_impl(
     # Keep only the replicated write requests the plan assigned to this
     # rank (plan computed identically on every rank from G1 — the
     # reference's rank-0-compute + broadcast is one more collective).
+    dropped_replicated: Dict[str, List] = {}
     if multi and replicated_entry_paths:
         from .partitioner import filter_assigned_write_reqs
 
-        write_reqs = filter_assigned_write_reqs(
+        write_reqs, dropped_replicated = filter_assigned_write_reqs(
             entries, write_reqs, replicated_entry_paths, assignment, rank
         )
 
@@ -1503,6 +1636,43 @@ def _take_impl(
     # otherwise); the scheduler's "stage_blocked"/"stage_window" op
     # spans are the interior measurements.
     mark("stage", write_reqs=len(write_reqs))
+    from .knobs import get_rank_failure_policy
+
+    if (
+        multi
+        and abort_ctx is not None
+        and abort_ctx.liveness is not None
+        and incremental_from is None
+        and not is_async_snapshot
+        and get_rank_failure_policy() == "degrade"
+    ):
+        # Everything a degraded commit needs is final here — armed
+        # BEFORE the manifest gather, the first all-ranks wait a dead
+        # peer can strand: from this point a RankFailedError in any
+        # collective or commit wait can hand the survivors a complete
+        # recovery context. Armed ONLY under the degrade policy (the
+        # retained dropped reqs pin the caller's replicated buffers
+        # across the commit window — a cost abort-mode users must not
+        # pay) and ONLY for sync takes: an async caller may mutate
+        # host-aliasing state the moment control returns, so adoption's
+        # re-staging could capture post-return bytes for the adopted
+        # values while the rest of the snapshot holds the capture-time
+        # state — async rank failures abort fast instead (still
+        # seconds, torn and salvageable). Incremental takes never
+        # degrade either (their dedup decisions reference per-rank base
+        # views the dead rank's evidence is part of).
+        abort_ctx.degrade = _DegradeContext(
+            comm=comm,
+            take_id=take_id,
+            storage=storage,
+            event_loop=event_loop,
+            entries=entries,
+            dropped_replicated=dropped_replicated,
+            assignment=assignment,
+            memory_budget=memory_budget,
+            extras=dict(extras) if extras else None,
+            pending_io_work=pending_io_work,
+        )
     global_manifest = _gather_manifest(entries, comm)
     mark("manifest_gather")
     import time
@@ -2073,6 +2243,340 @@ class _TelemetryCommit:
                 pass
 
 
+# ----------------------------------------------------- degraded commit
+
+
+class _DegradeContext:
+    """Everything the survivors of a rank failure need to finish a
+    replicated-only take without the dead rank(s): the fully-annotated
+    local manifest (entries carry their checksums once writes drain),
+    the partition plan, and this rank's UNSTAGED write requests for
+    replicated units assigned to other ranks — identical bytes, so any
+    survivor can adopt a dead writer's assignments."""
+
+    def __init__(
+        self,
+        comm: Communicator,
+        take_id: str,
+        storage: StoragePlugin,
+        event_loop: asyncio.AbstractEventLoop,
+        entries: Manifest,
+        dropped_replicated: Dict[str, List],
+        assignment: Dict[str, int],
+        memory_budget: int,
+        extras: Optional[Dict[str, Any]],
+        pending_io_work: PendingIOWork,
+    ) -> None:
+        self.comm = comm
+        self.take_id = take_id
+        self.storage = storage
+        self.event_loop = event_loop
+        self.entries = entries
+        self.dropped_replicated = dropped_replicated
+        self.assignment = assignment
+        self.memory_budget = memory_budget
+        self.extras = extras
+        self.pending_io_work = pending_io_work
+
+
+def _degrade_eligible(per_rank_entries: List[Manifest]) -> Optional[str]:
+    """None when every survivor leaf entry is replicated (the SPMD
+    program shape proves the dead rank's were too — its bytes exist on
+    every survivor); otherwise the reason degrading is impossible. A
+    sharded or per-rank-unique entry on any survivor means the dead
+    rank held unique partitions whose bytes died with it."""
+    from .manifest import PrimitiveEntry
+
+    for entries in per_rank_entries:
+        for path, entry in entries.items():
+            if is_container_entry(entry):
+                continue
+            if is_replicated(entry):
+                continue
+            kind = type(entry).__name__
+            if isinstance(entry, PrimitiveEntry):
+                return (
+                    f"{path!r} is a per-rank primitive (not replicated-"
+                    "glob-marked); the dead rank's value is unknowable"
+                )
+            return f"{path!r} is {kind}: the dead rank held unique state"
+    return None
+
+
+def _degraded_prefix(take_id: str) -> str:
+    return f"tpusnap_degraded/{take_id}"
+
+
+def _maybe_degraded_commit(
+    abort_ctx: Optional["_TakeAbortContext"],
+    exc: RankFailedError,
+) -> Optional[SnapshotMetadata]:
+    """Entry point for both commit paths' ``except RankFailedError``:
+    returns the committed (degraded) metadata when the policy allows
+    and the take is eligible, None when degrade mode is off or the
+    failure predates the recovery context. Raises (RankFailedError with
+    the eligibility reason, or whatever the degraded protocol hit) when
+    degrade was attempted and could not complete — the caller then
+    aborts to a torn state exactly as in abort mode."""
+    from .knobs import get_rank_failure_policy
+
+    if (
+        abort_ctx is None
+        or abort_ctx.degrade is None
+        or abort_ctx.liveness is None
+        or get_rank_failure_policy() != "degrade"
+    ):
+        return None
+    return _degraded_commit(abort_ctx, exc)
+
+
+def _degraded_commit(
+    abort_ctx: "_TakeAbortContext", exc: RankFailedError
+) -> SnapshotMetadata:
+    """Complete a replicated-only take on the survivor set.
+
+    Pure KV + storage traffic over take-scoped keys (legal from the
+    async commit's background thread, independent of the communicator's
+    possibly-desynced sequence counters):
+
+    1. every survivor publishes its fully-annotated local manifest and
+       meets a survivor-set LinearBarrier (liveness-watched, with the
+       acknowledged dead set excluded);
+    2. eligibility: every survivor leaf must be replicated — else raise
+       (abort to torn; fsck/timeline name the dead rank);
+    3. adoption: units the dead rank(s) were assigned are re-planned
+       deterministically across the survivors
+       (``partitioner.reassign_dead_units``); each adopter stages and
+       writes its own identical-bytes copies (journal evidence recorded
+       as usual) and publishes the adopted entry versions;
+    4. the new leader (min survivor) consolidates the survivor
+       manifests, substitutes the adopted entries, records the adoption
+       under ``extras["degraded"]``, and commits; a final barrier gates
+       journal/KV cleanup.
+
+    All survivors compute every decision from identical gathered inputs
+    — no broadcasts. A survivor whose dead-set observation diverges
+    (two near-simultaneous failures racing detection) parks in a
+    barrier the others never join and aborts at the barrier timeout:
+    degraded commit fails safe to torn, never to a wrong manifest."""
+    import pickle
+    import time as _time
+
+    from . import flight as _flight_mod
+    from .partitioner import (
+        consolidate_replicated_entries,
+        reassign_dead_units,
+    )
+
+    ctx = abort_ctx.degrade
+    liveness = abort_ctx.liveness
+    comm, rank = ctx.comm, ctx.comm.rank
+    dead = sorted(set(exc.ranks) | set(liveness.expired()))
+    live = sorted(set(range(comm.world_size)) - set(dead))
+    if rank not in live or not dead:
+        raise exc
+    leader = live[0]
+    logger.warning(
+        "tpusnap degraded commit: rank(s) %s died during take %s; "
+        "%d survivor(s) attempting to complete it (leader: rank %d)",
+        dead,
+        ctx.take_id[:8],
+        len(live),
+        leader,
+    )
+    _flight_mod.record("degraded_commit", op="start", dead_ranks=dead)
+    kv = _get_kv_store(comm)
+    prefix = _degraded_prefix(ctx.take_id)
+    watchers = [liveness.watcher(exclude=set(dead))]
+    if abort_ctx.monitor is not None:
+        watchers.append(abort_ctx.monitor.check)
+
+    def barrier(name: str) -> None:
+        b = LinearBarrier(
+            store=kv,
+            prefix=f"{prefix}/{name}",
+            rank=rank,
+            world_size=comm.world_size,
+            ranks=live,
+            watchers=watchers,
+        )
+        b.arrive()
+        b.depart()
+
+    # 0. This rank's writes must be fully drained — the published
+    # entries carry their write-path checksums only then.
+    if not ctx.pending_io_work.drained():
+        ctx.pending_io_work.sync_complete(ctx.event_loop)
+
+    # 1. Publish + gather the survivor manifests.
+    kv.set(f"{prefix}/m/{rank}", pickle.dumps(ctx.entries))
+    barrier("b1")
+    blobs = kv.try_get_dir(f"{prefix}/m/") or {}
+    per_rank: List[Manifest] = [{} for _ in range(comm.world_size)]
+    got = set()
+    for key, raw in blobs.items():
+        try:
+            r = int(key.rsplit("/", 1)[-1])
+        except ValueError:
+            continue
+        if r in live:
+            per_rank[r] = pickle.loads(raw)
+            got.add(r)
+    for r in live:
+        if r not in got:
+            # Torn dir listing (the barrier proved the publish): per-key
+            # fallback, bounded.
+            per_rank[r] = pickle.loads(
+                kv.get(f"{prefix}/m/{r}", timeout_sec=120.0)
+            )
+
+    # 2. Eligibility — identical verdict on every survivor.
+    reason = _degrade_eligible([per_rank[r] for r in live])
+    if reason is not None:
+        _flight_mod.record("degraded_commit", op="refused", reason=reason)
+        raise RankFailedError(
+            dead,
+            ctx.take_id,
+            detail=f"degrade refused: {reason}; aborting to a torn state",
+        ) from exc
+
+    # 3. Adoption: deterministic re-plan, then each adopter stages and
+    # writes its own replicated copies of the dead writers' units.
+    adoption = reassign_dead_units(ctx.assignment, dead, live)
+    my_units = sorted(u for u, w in adoption.items() if w == rank)
+    my_reqs = [
+        wr for u in my_units for wr in ctx.dropped_replicated.get(u, [])
+    ]
+    if my_reqs:
+        adopt_work = sync_execute_write_reqs(
+            my_reqs,
+            ctx.storage,
+            ctx.memory_budget,
+            rank,
+            ctx.event_loop,
+        )
+        adopt_work.sync_complete(ctx.event_loop)
+    adopted_payload = {}
+    for u in adoption:
+        if adoption[u] != rank:
+            continue
+        path, _, chunk = u.partition("::")
+        entry = ctx.entries.get(path)
+        if entry is None:
+            continue
+        adopted_payload[u] = entry
+    kv.set(f"{prefix}/a/{rank}", pickle.dumps(adopted_payload))
+    barrier("b2")
+
+    # 4. Every survivor builds the identical degraded manifest (the
+    # leader's copy is the one that commits; the others cache it).
+    # Replicated entries consolidate into rank 0's tree — when rank 0
+    # itself died, stand the new leader's (SPMD-identical) manifest in
+    # for slot 0 so the replicated tree still materializes.
+    if 0 in dead:
+        per_rank[0] = per_rank[leader]
+    global_manifest = consolidate_replicated_entries(per_rank)
+    # Same torn-listing defense as the /m/ gather: barrier b2 proved
+    # every survivor published, so a rank missing from the dir read
+    # gets a bounded per-key fallback — and an unreadable blob RAISES
+    # (degrade fails safe to torn) rather than silently committing a
+    # manifest missing that adopter's substitutions.
+    adopted_blobs = kv.try_get_dir(f"{prefix}/a/") or {}
+    adopted_by_rank: Dict[int, bytes] = {}
+    for key, raw in adopted_blobs.items():
+        try:
+            r = int(key.rsplit("/", 1)[-1])
+        except ValueError:
+            continue
+        if r in live:
+            adopted_by_rank[r] = raw
+    for r in live:
+        if r not in adopted_by_rank:
+            adopted_by_rank[r] = kv.get(
+                f"{prefix}/a/{r}", timeout_sec=120.0
+            )
+    n_adopted = 0
+    for _r, raw in sorted(adopted_by_rank.items()):
+        payload = pickle.loads(raw)
+        for unit, entry in sorted(payload.items()):
+            path, _, chunk = unit.partition("::")
+            gkey = f"0/{path}"
+            if gkey not in global_manifest:
+                continue
+            n_adopted += 1
+            if chunk:
+                # Chunk-grain adoption: substitute only the dead
+                # writer's chunk; live writers' chunks keep their
+                # (possibly annotated) versions.
+                idx = int(chunk)
+                cur = global_manifest[gkey]
+                if hasattr(cur, "chunks") and idx < len(cur.chunks):
+                    cur.chunks[idx] = entry.chunks[idx]
+            else:
+                # Whole-entry adoption: the authoritative (dead
+                # writer's) version may reference a slab or carry stale
+                # annotations — the adopter's entry describes the blob
+                # it actually wrote.
+                global_manifest[gkey] = entry
+    extras = dict(ctx.extras or {})
+    extras["degraded"] = {
+        "dead_ranks": dead,
+        "live_ranks": live,
+        "adopted_units": sorted(adoption),
+        "adopters": {u: w for u, w in sorted(adoption.items())},
+    }
+    metadata = SnapshotMetadata(
+        version=__version__,
+        world_size=comm.world_size,
+        manifest=global_manifest,
+        created_at=_time.time(),
+        extras=extras,
+    )
+    if rank == leader:
+        abort_ctx.mark_commit_started()
+        _write_metadata(ctx.storage, metadata, ctx.event_loop)
+    barrier("b3")
+    if rank == leader:
+        from .knobs import is_journal_disabled
+        from .lifecycle import clear_journal
+
+        if not is_journal_disabled():
+            clear_journal(
+                ctx.storage,
+                ctx.event_loop,
+                getattr(ctx.storage, "clear_world_size", comm.world_size),
+            )
+        if abort_ctx.monitor is not None:
+            abort_ctx.monitor.clear()
+        if abort_ctx.lease is not None:
+            abort_ctx.lease.cleanup()
+        # The normal commit's leader cleanup never ran: sweep this
+        # take's transport prefixes (late checksums / telemetry
+        # summaries some ranks may have published before the death)
+        # along with the degraded protocol's own keys.
+        for p in (
+            prefix + "/",
+            f"tpusnap_late_cs/{ctx.take_id}/",
+            f"tpusnap_tele/{ctx.take_id}/",
+        ):
+            try:
+                kv.delete_prefix(p)
+            except Exception:
+                logger.debug("degraded KV cleanup failed", exc_info=True)
+    _flight_mod.record(
+        "degraded_commit", op="committed", dead_ranks=dead, adopted=n_adopted
+    )
+    logger.warning(
+        "tpusnap degraded commit SUCCEEDED: take %s committed by %d "
+        "survivor(s); rank(s) %s's %d replicated unit(s) were adopted",
+        ctx.take_id[:8],
+        len(live),
+        dead,
+        n_adopted,
+    )
+    return metadata
+
+
 def _record_slo_commit(
     tele: Optional[telemetry.TakeTelemetry],
     metadata: SnapshotMetadata,
@@ -2331,7 +2835,9 @@ class PendingSnapshot(_BackgroundWork):
     (content frozen); ``wait()`` the committed snapshot.
     """
 
-    BARRIER_TIMEOUT_SEC = 1800.0  # reference snapshot.py:857
+    # Historically a 1800.0 literal (reference snapshot.py:857); now
+    # 3x TPUSNAP_BARRIER_TIMEOUT_S (knobs.get_commit_barrier_timeout_s),
+    # resolved at construction.
     _thread_name = "tpusnap-commit"
 
     def __init__(
@@ -2378,16 +2884,22 @@ class PendingSnapshot(_BackgroundWork):
         # everything pending NOW; collectives the main thread issues
         # later (a newer take on the same communicator) stay pending.
         self._gc_epoch = comm.gc_epoch()
-        monitor = abort_ctx.monitor if abort_ctx is not None else None
+        from .knobs import get_commit_barrier_timeout_s
+
+        commit_timeout = get_commit_barrier_timeout_s()
+        # Peer abort records surface as TakeAbortedError — and a dead
+        # peer's lease expiry as RankFailedError — from the background
+        # commit's barrier waits within seconds.
+        watchers = (
+            abort_ctx.barrier_watchers() if abort_ctx is not None else None
+        )
         self._barrier = LinearBarrier(
             store=_get_kv_store(comm),
             prefix=barrier_prefix,
             rank=comm.rank,
             world_size=comm.world_size,
-            timeout_sec=self.BARRIER_TIMEOUT_SEC,
-            # Peer abort records surface as TakeAbortedError from the
-            # background commit's barrier waits within seconds.
-            watchers=[monitor.check] if monitor is not None else None,
+            timeout_sec=commit_timeout,
+            watchers=watchers or None,
         )
         # The cleanup gate (ADVICE r5 #4): after the commit barrier's
         # depart, every rank patches its local manifest copy from the
@@ -2399,8 +2911,8 @@ class PendingSnapshot(_BackgroundWork):
                 prefix=barrier_prefix + "-post",
                 rank=comm.rank,
                 world_size=comm.world_size,
-                timeout_sec=self.BARRIER_TIMEOUT_SEC,
-                watchers=[monitor.check] if monitor is not None else None,
+                timeout_sec=commit_timeout,
+                watchers=watchers or None,
             )
             if comm.world_size > 1
             else None
@@ -2434,6 +2946,13 @@ class PendingSnapshot(_BackgroundWork):
         self._start()
 
     def _body(self) -> None:
+        # A RankFailedError from the barrier waits here takes the
+        # normal abort path (_on_error): async takes never run the
+        # degraded commit — the caller may mutate host-aliasing state
+        # the moment async_take returns, so adoption's re-staging could
+        # capture post-return bytes (the degrade context is only armed
+        # for sync takes). Detection is still seconds, and the torn
+        # state salvages on retake.
         tele = self._tele_commit.tele if self._tele_commit is not None else None
         with telemetry.use(tele):
             self._body_impl()
@@ -2534,6 +3053,11 @@ class PendingSnapshot(_BackgroundWork):
                 and self._abort_ctx.monitor is not None
             ):
                 self._abort_ctx.monitor.clear()
+            if (
+                self._abort_ctx is not None
+                and self._abort_ctx.lease is not None
+            ):
+                self._abort_ctx.lease.cleanup()
         # Every rank departing proves it consumed the take's gathers
         # and the barrier-prefix broadcast; release their KV keys now
         # — no further barrier will run on this communicator, so the
