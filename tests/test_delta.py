@@ -338,7 +338,10 @@ def test_stream_anchors_slo_tracker(tmp_path):
     assert st["stream_cadence_s"] is None
 
 
-def test_stream_multiprocess_raises(tmp_path):
+def test_stream_multiprocess_needs_coordination(tmp_path):
+    """A multi-process stream runs its elastic control plane over the
+    jax.distributed coordination KV — opening one without the service
+    must fail loudly, not wedge."""
     from tpusnap.comm import Communicator
 
     class FakeMulti(Communicator):
@@ -346,28 +349,57 @@ def test_stream_multiprocess_raises(tmp_path):
         def world_size(self):
             return 2
 
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(RuntimeError, match="jax.distributed"):
         Snapshot.stream(
             str(tmp_path / "s"), _state(), comm=FakeMulti()
         )
 
 
-def test_stream_refuses_nonempty_root(tmp_path):
-    """Reopening a root that already holds chain members must refuse:
-    a fresh base-000000 under committed deltas would silently change
-    the bytes their '../base-000000' references resolve to."""
+def test_stream_resumes_committed_chain(tmp_path):
+    """Reopening a stream root RESUMES the committed chain across
+    process lifetimes: the new stream adopts the head's stream id and
+    sequence, takes no new base, and its first micro-commit extends
+    the existing chain."""
     root = str(tmp_path / "stream")
     state = _state(11)
     s = Snapshot.stream(root, state, cadence_s=3600)
+    sid = s.stream_id
     state["app"]["w"][0, 0] = 1.0
     s.commit_now()
     s.close(final_commit=False)
-    with pytest.raises(ValueError, match="already holds delta-stream"):
-        Snapshot.stream(root, state, cadence_s=3600)
-    # The refused open must not have disturbed the existing chain.
-    rep = resolve_chain(root)
-    assert rep.head is not None
-    assert verify_snapshot(rep.head_path).clean
+
+    s2 = Snapshot.stream(root, state, cadence_s=3600)
+    try:
+        assert s2.stream_id == sid
+        assert s2.seq == 1  # adopted, not reset
+        state["app"]["w"][0, 1] = 2.0
+        snap = s2.commit_now()
+        assert s2.seq == 2
+        # No second base: the resumed commit extends the old chain.
+        assert not os.path.isdir(
+            os.path.join(root, "base-000001")
+        )
+        restored = _state()
+        snap.restore(restored)
+        np.testing.assert_array_equal(
+            restored["app"]["w"], state["app"]["w"]
+        )
+        rep = resolve_chain(root)
+        assert rep.head == member_name(2)
+        assert member_name(0) in rep.chain
+        assert verify_snapshot(rep.head_path).clean
+    finally:
+        s2.close(final_commit=False)
+
+
+def test_stream_refuses_foreign_root(tmp_path):
+    """A root holding committed NON-stream snapshots still refuses: a
+    fresh base under foreign snapshot dirs would silently change what
+    the directory means."""
+    root = str(tmp_path / "root")
+    Snapshot.take(os.path.join(root, "plain"), _state(11))
+    with pytest.raises(ValueError, match="non-stream"):
+        Snapshot.stream(root, _state(11), cadence_s=3600)
 
 
 def test_stream_rejects_nonpositive_cadence(tmp_path):

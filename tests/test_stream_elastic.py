@@ -1,0 +1,575 @@
+"""Elastic multi-process delta streams (ISSUE 16).
+
+Crash matrix for ``Snapshot.stream`` with ``world_size > 1``:
+
+- a rank SIGKILLed mid-micro-commit must NOT kill the stream —
+  fully-replicated epochs commit DEGRADED and streaming continues on
+  the survivors (the acceptance scenario);
+- sharded state cannot be adopted, so the same death tears the epoch
+  and PAUSES the stream (named, policy-handled — never a wedge); a
+  fresh world reopening the root RESUMES the committed chain and the
+  retake salvages the torn member's journal-proven bytes;
+- a graceful ``leave()`` plus a later re-join re-plan the world at the
+  next capture boundary, with the joins/leaves recorded per epoch in
+  ``extras["delta"]["world"]``.
+
+Plus unit coverage for the satellites that ride along: the ``preempt``
+fault kind, the terminal ``left`` lease state, the ``slo --check``
+stream-cadence gate, and the fsck/info chain-report world rendering.
+"""
+
+import os
+import re
+import signal
+import time
+
+import numpy as np
+import pytest
+
+# Mirrors tests/test_liveness.py: tight leases so detection fits the
+# test budget, batching off so retake layouts match for salvage.
+_TTL = 2.0
+_ENV = {
+    "TPUSNAP_LIVENESS_TTL_S": "2.0",
+    "TPUSNAP_HEARTBEAT_INTERVAL_S": "0.1",
+    "TPUSNAP_DISABLE_BATCHING": "1",
+    "TPUSNAP_HISTORY": "0",
+    "TPUSNAP_RANK_FAILURE": "degrade",
+}
+
+
+def _state(nbytes_per_arr=1 << 16, n=4, seed=7):
+    rng = np.random.default_rng(seed)
+    return {
+        f"w{i}": rng.standard_normal(nbytes_per_arr // 8).astype(np.float64)
+        for i in range(n)
+    }
+
+
+def _arm_kill_on_next_write(armed):
+    """Rank-local: SIGKILL this process on the first storage write
+    (blob payloads only, not lifecycle sidecars) after ``armed[0]``
+    flips — the deterministic 'die mid-micro-commit' window."""
+    import tpusnap.storage_plugins.fs as fs_mod
+
+    orig_write = fs_mod.FSStoragePlugin.write
+
+    async def hooked_write(self, write_io):
+        await orig_write(self, write_io)
+        if armed[0] and not write_io.path.startswith(".tpusnap"):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    fs_mod.FSStoragePlugin.write = hooked_write
+
+
+def _wait(pred, deadline_s, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        if pred():
+            return time.monotonic() - t0
+        time.sleep(0.05)
+    raise AssertionError(f"timed out after {deadline_s}s waiting for {what}")
+
+
+# --------------------------------------------------------------------------
+# (a) Replicated stream survives SIGKILL of a rank: degraded epoch,
+#     then solo epochs — the ISSUE 16 acceptance scenario.
+# --------------------------------------------------------------------------
+
+
+def _world_stream_survives_sigkill(root):
+    from tpusnap import Snapshot, StateDict
+    from tpusnap.comm import get_communicator
+    from tpusnap.delta import resolve_chain
+
+    comm = get_communicator()
+    arrays = _state(seed=11)
+    state = {"m": StateDict(step=7, **arrays)}
+
+    armed = [False]
+    if comm.rank == 1:
+        _arm_kill_on_next_write(armed)
+
+    stream = Snapshot.stream(root, state, cadence_s=0.5, replicated=["**"])
+    # Base + one clean multi-rank epoch first, so the kill lands inside
+    # a DELTA micro-commit (the same gate arms both ranks' clocks).
+    _wait(lambda: stream.stats["commits"] >= 2, 45, "base + first epoch")
+    # Mutate in place (identically on both ranks — replicated state)
+    # right after a commit landed: the next epoch has REAL blob writes
+    # for the kill hook to land in, and the mutation is done long
+    # before the next cadence boundary captures it.
+    for v in arrays.values():
+        v += 1.0
+    t_armed = time.monotonic()
+    armed[0] = True  # rank 1 dies on its next blob write
+
+    if comm.rank == 1:
+        time.sleep(120)
+        os._exit(3)  # the hooked write should have SIGKILLed us
+    _wait(
+        lambda: stream.stats["degraded_epochs"] >= 1,
+        3 * _TTL + 30,
+        "a degraded epoch",
+    )
+    dt = time.monotonic() - t_armed
+    print(f"STREAM-DEGRADED dt={dt:.1f}", flush=True)
+    # The stream is not paused and keeps committing WITHOUT rank 1.
+    assert not stream.paused
+    after = stream.stats["commits"]
+    _wait(lambda: stream.stats["commits"] > after, 30, "a post-death epoch")
+    assert stream.members == [0], stream.members
+    stream.close(final_commit=False)
+
+    rep = resolve_chain(root)
+    assert rep.head and not rep.torn_tail, rep.summary()
+    assert "DEGRADED" in rep.summary(), rep.summary()
+    by_name = {m.name: m for m in rep.members}
+    deg = [m for m in rep.members if m.degraded]
+    assert deg and deg[0].degraded["dead_ranks"], rep.summary()
+    # Per-epoch world forensics: the degraded epoch ran the full world;
+    # the head (post-death) epoch re-planned down to the survivor.
+    assert deg[0].world and deg[0].world["ranks"] == [0, 1], deg[0]
+    head = by_name[rep.head]
+    assert head.world and head.world["ranks"] == [0], head
+    assert head.world.get("left") == [1] or head.world.get("expired") == [1]
+
+    # Bit-exact restore from the survivor-committed chain.
+    target = {
+        "m": StateDict(
+            step=0, **{k: np.zeros_like(v) for k, v in arrays.items()}
+        )
+    }
+    Snapshot(rep.head_path).restore(target)
+    assert target["m"]["step"] == 7
+    for k, v in arrays.items():
+        assert np.array_equal(target["m"][k], v), k
+    from tpusnap import verify_snapshot
+
+    vr = verify_snapshot(rep.head_path)
+    assert vr.clean and not vr.corrupt, vr
+    print("STREAM-SURVIVED-OK", flush=True)
+    os._exit(0)  # skip the shutdown rendezvous with the dead peer
+
+
+@pytest.mark.distributed
+def test_stream_survives_rank_sigkill(tmp_path):
+    from tpusnap.test_utils import run_subprocess_world
+
+    root = str(tmp_path / "stream_sigkill")
+    with pytest.raises(RuntimeError) as ei:
+        run_subprocess_world(
+            _world_stream_survives_sigkill,
+            world_size=2,
+            args=[root],
+            extra_env=_ENV,
+            timeout=150,
+        )
+    logs = str(ei.value)
+    assert "STREAM-SURVIVED-OK" in logs, logs[-4000:]
+    m = re.search(r"STREAM-DEGRADED dt=([0-9.]+)", logs)
+    assert m, logs[-4000:]
+    # Death -> degraded epoch within detection (<= 3x TTL) plus one
+    # cadence + the adoption protocol (generous CI slack).
+    assert float(m.group(1)) <= 3 * _TTL + 25
+
+
+# --------------------------------------------------------------------------
+# (b) Sharded stream: death tears the epoch and PAUSES the stream;
+#     a fresh world reopening the root resumes + salvages.
+# --------------------------------------------------------------------------
+
+
+def _make_sharded(bump):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devices = np.array(jax.devices()).reshape(-1)
+    mesh = Mesh(devices, ("x",))
+    sharding = NamedSharding(mesh, PartitionSpec("x"))
+    n = len(devices) * 8
+    full = np.arange(n * 512, dtype=np.float32).reshape(n, 512) + bump
+    return jax.make_array_from_callback(
+        full.shape, sharding, lambda idx: full[idx]
+    )
+
+
+def _sharded_state(bump=0.0):
+    from tpusnap import StateDict
+
+    arrays = {k: v + bump for k, v in _state(n=2, seed=3).items()}
+    return {"m": StateDict(s=_make_sharded(bump), **arrays)}
+
+
+def _world_stream_sharded_pause(root):
+    from tpusnap import Snapshot
+    from tpusnap.comm import get_communicator
+
+    comm = get_communicator()
+    state = _sharded_state()
+
+    armed = [False]
+    if comm.rank == 1:
+        _arm_kill_on_next_write(armed)
+
+    stream = Snapshot.stream(root, state, cadence_s=0.5, replicated=["m/w*"])
+    _wait(lambda: stream.stats["commits"] >= 2, 60, "base + first epoch")
+    # Swap in bump=1 state (identically on both ranks) so the next
+    # epoch has real writes; the resume world reconstructs the SAME
+    # bump=1 state, which is what makes the torn member's journaled
+    # bytes salvageable on the retake.
+    for k, v in _sharded_state(bump=1.0)["m"].items():
+        state["m"][k] = v
+    armed[0] = True
+
+    if comm.rank == 1:
+        time.sleep(120)
+        os._exit(3)  # the hooked write should have SIGKILLed us
+    _wait(lambda: stream.paused, 3 * _TTL + 30, "stream pause")
+    info = stream.pause_info
+    assert info and info["dead_ranks"] == [1], info
+    assert info["member"], info
+    # Paused is terminal-but-named: closed, not failed.
+    assert stream.closed
+    stream.raise_if_failed()  # a pause is NOT a worker failure
+    print(f"STREAM-PAUSED-OK member={info['member']}", flush=True)
+    os._exit(0)  # skip the shutdown rendezvous with the dead peer
+
+
+def _world_stream_resume_salvages(root):
+    from tpusnap import Snapshot, telemetry, verify_snapshot
+    from tpusnap.comm import get_communicator
+    from tpusnap.delta import resolve_chain
+
+    comm = get_communicator()
+    state = _sharded_state(bump=1.0)  # what the torn epoch captured
+    before = resolve_chain(root)
+    assert before.torn_tail, before.summary()
+    committed_seq = max(
+        m.seq for m in before.members if m.state == "committed"
+    )
+
+    salv0 = telemetry.counter_value("salvage.bytes_salvaged")
+    stream = Snapshot.stream(root, state, cadence_s=0.5, replicated=["m/w*"])
+    # RESUME, not a second base: the committed chain's identity and seq
+    # carry over across process lifetimes.
+    assert stream.seq == committed_seq, (stream.seq, committed_seq)
+    _wait(lambda: stream.stats["commits"] >= 1, 60, "resumed micro-commit")
+    salvaged = telemetry.counter_value("salvage.bytes_salvaged") - salv0
+    stream.close(final_commit=False)
+
+    rep = resolve_chain(root)
+    assert rep.head and not rep.torn_tail, rep.summary()
+    assert not os.path.isdir(os.path.join(root, "base-000001"))
+    if comm.rank == 0:
+        # The retake of the torn member reused the survivor's
+        # journal-proven bytes instead of rewriting them.
+        assert salvaged > 0, salvaged
+        vr = verify_snapshot(rep.head_path)
+        assert vr.clean and not vr.corrupt, vr
+        print(f"STREAM-RESUMED-OK salvaged={salvaged}", flush=True)
+
+
+@pytest.mark.distributed
+def test_stream_sharded_death_pauses_then_resume_salvages(tmp_path):
+    from tpusnap.test_utils import run_subprocess_world
+
+    root = str(tmp_path / "stream_sharded")
+    with pytest.raises(RuntimeError) as ei:
+        run_subprocess_world(
+            _world_stream_sharded_pause,
+            world_size=2,
+            args=[root],
+            extra_env=_ENV,
+            timeout=150,
+        )
+    logs = str(ei.value)
+    assert "STREAM-PAUSED-OK" in logs, logs[-4000:]
+
+    # The torn epoch kept its salvage substrate and named the world.
+    from tpusnap.delta import resolve_chain
+
+    rep = resolve_chain(root)
+    assert rep.torn_tail, rep.summary()
+    torn = next(m for m in rep.members if m.name == rep.torn_tail)
+    assert torn.world and torn.world["ranks"] == [0, 1], torn
+
+    # A FRESH world reopens the root: the stream resumes the committed
+    # chain and the retake salvages the torn member.
+    logs2 = run_subprocess_world(
+        _world_stream_resume_salvages,
+        world_size=2,
+        args=[root],
+        extra_env=_ENV,
+        timeout=150,
+    )
+    assert any("STREAM-RESUMED-OK" in log for log in logs2), logs2
+
+
+# --------------------------------------------------------------------------
+# (c) Graceful leave + later re-join: the world re-plans at the next
+#     capture boundary and the per-epoch record names both events.
+# --------------------------------------------------------------------------
+
+
+def _touch(path):
+    with open(path, "w") as f:
+        f.write("1")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _world_stream_leave_rejoin(root, sync_dir):
+    from tpusnap import Snapshot, StateDict
+    from tpusnap.comm import get_communicator
+
+    comm = get_communicator()
+    arrays = _state(n=3, seed=5)
+    state = {"m": StateDict(step=1, **arrays)}
+    stream = Snapshot.stream(root, state, cadence_s=0.4, replicated=["**"])
+    _wait(lambda: stream.stats["commits"] >= 2, 45, "base + first epoch")
+
+    if comm.rank == 1:
+        head = stream.leave()
+        assert head is not None  # committed recovery point exists
+        assert stream.closed and not stream.paused
+        print("R1-LEFT", flush=True)
+        # Re-join the still-live stream on the same root: a solo open
+        # against the incumbents' registration, no collectives.
+        st2 = Snapshot.stream(root, state, cadence_s=0.4, replicated=["**"])
+        assert st2.stats["joins"] == 1
+        _wait(
+            lambda: st2.stats["commits"] >= 1 and 1 in st2.members,
+            45,
+            "re-joined epoch",
+        )
+        print("R1-REJOINED", flush=True)
+        _wait(lambda: os.path.exists(os.path.join(sync_dir, "r0_done")), 45,
+              "rank 0 ack")
+        st2.leave()
+    else:
+        _wait(lambda: stream.members == [0], 45, "solo epoch after leave")
+        print("R0-SAW-LEAVE", flush=True)
+        _wait(lambda: stream.members == [0, 1], 45, "re-planned epoch")
+        print("R0-SAW-REJOIN", flush=True)
+        _touch(os.path.join(sync_dir, "r0_done"))
+        _wait(lambda: stream.members == [0], 45, "second leave")
+        stream.close(final_commit=False)
+
+
+@pytest.mark.distributed
+def test_stream_graceful_leave_and_rejoin(tmp_path):
+    from tpusnap.test_utils import run_subprocess_world
+
+    root = str(tmp_path / "stream_elastic")
+    sync = str(tmp_path / "sync")
+    os.makedirs(sync, exist_ok=True)
+    logs = run_subprocess_world(
+        _world_stream_leave_rejoin,
+        world_size=2,
+        args=[root, sync],
+        extra_env=_ENV,
+        timeout=150,
+    )
+    joined = "\n".join(logs)
+    for marker in ("R1-LEFT", "R1-REJOINED", "R0-SAW-LEAVE", "R0-SAW-REJOIN"):
+        assert marker in joined, joined[-4000:]
+
+    # The chain records the resize: one epoch shrank (left [1]), a
+    # later one re-grew (joined [1]); restore stays bit-exact.
+    from tpusnap import Snapshot, StateDict
+    from tpusnap.delta import resolve_chain
+
+    rep = resolve_chain(root)
+    assert rep.head and not rep.torn_tail, rep.summary()
+    worlds = [m.world for m in rep.members if m.world]
+    assert any(w.get("left") == [1] for w in worlds), worlds
+    assert any(w.get("joined") == [1] for w in worlds), worlds
+
+    arrays = _state(n=3, seed=5)
+    target = {
+        "m": StateDict(
+            step=0, **{k: np.zeros_like(v) for k, v in arrays.items()}
+        )
+    }
+    Snapshot(rep.head_path).restore(target)
+    assert target["m"]["step"] == 1
+    for k, v in arrays.items():
+        assert np.array_equal(target["m"][k], v), k
+
+
+# --------------------------------------------------------------------------
+# Satellite units: preempt fault kind
+# --------------------------------------------------------------------------
+
+
+def test_preempt_spec_parses():
+    from tpusnap.faults import FaultPlan
+
+    plan = FaultPlan.from_spec("preempt=write:3:30")
+    assert plan.preempt == ("write", 3, 30.0)
+    plan = FaultPlan.from_spec("rank=1,preempt=write:*:5")
+    assert plan.preempt == ("write", 0, 5.0)
+    assert plan.rank == 1
+    with pytest.raises(ValueError):
+        FaultPlan.from_spec("preempt=write:3")  # grace_s is required
+
+
+def test_preempt_delivers_sigterm_once_with_kill_deadline(monkeypatch):
+    from tpusnap import faults
+
+    sent = []
+    timers = []
+
+    class FakeTimer:
+        def __init__(self, interval, fn):
+            timers.append(interval)
+            self.daemon = False
+
+        def start(self):
+            pass
+
+    monkeypatch.setattr(faults.os, "kill", lambda pid, sig: sent.append(sig))
+    monkeypatch.setattr(faults.threading, "Timer", FakeTimer)
+
+    plugin = faults.FaultInjectionStoragePlugin(
+        inner=None, plan=faults.FaultPlan.from_spec("preempt=write:2:7.5")
+    )
+    plugin._check_preempt("write")  # attempt 1: not yet
+    assert sent == []
+    plugin._check_preempt("write")  # attempt 2: SIGTERM + armed SIGKILL
+    assert sent == [signal.SIGTERM]
+    assert timers == [7.5]
+    plugin._check_preempt("write")  # fires at most once
+    plugin._check_preempt("write")
+    assert sent == [signal.SIGTERM]
+
+
+# --------------------------------------------------------------------------
+# Satellite units: terminal `left` lease state
+# --------------------------------------------------------------------------
+
+
+def test_monitor_never_expires_a_left_rank():
+    from tpusnap.dist_store import MemoryKVStore
+    from tpusnap.liveness import LeasePublisher, LivenessMonitor
+
+    kv = MemoryKVStore()
+    t = [100.0]
+    mon = LivenessMonitor(
+        kv, "take-x", rank=0, world_size=2, ttl_s=1.0, clock=lambda: t[0]
+    )
+    p0 = LeasePublisher(kv, "take-x", 0)
+    p1 = LeasePublisher(kv, "take-x", 1)
+    p0.publish()
+    p1.publish()
+    mon.check()  # both live
+    # Rank 1 leaves gracefully, then goes silent for many TTLs: no
+    # expiry, no RankFailedError — and the departure is queryable.
+    p1.leave()
+    for _ in range(20):
+        t[0] += 1.0
+        p0.publish()
+        mon.check()
+    assert mon.left_ranks() == [1]
+    assert not mon.dead_ranks()
+
+
+# --------------------------------------------------------------------------
+# Satellite units: slo --check stream-cadence gate
+# --------------------------------------------------------------------------
+
+
+def test_slo_stream_cadence_gate():
+    from tpusnap.knobs import override_slo_stream_cadence_x
+    from tpusnap.slo import evaluate_records
+
+    now = 1_000_000.0
+    rec = {
+        "rank": 0,
+        "world_size": 2,
+        "ts": now - 10.0,
+        "last_commit_ts": now - 10.0,
+        "stream_cadence_s": 1.0,
+    }
+    out = evaluate_records([dict(rec)], now=now)
+    assert out["verdict"] == "breach", out
+    row = out["ranks"][0]
+    assert row["breach_stream"] and not row["breach_rpo"], row
+    assert "cadence" in out["reason"], out["reason"]
+    assert out["thresholds"]["stream_cadence_x"] == 3.0
+
+    # A FINAL record is a clean exit, not a stalled stream.
+    out = evaluate_records([dict(rec, final=True)], now=now)
+    assert out["verdict"] == "healthy", out
+    # Within N x cadence: healthy.
+    out = evaluate_records(
+        [dict(rec, last_commit_ts=now - 2.0)], now=now
+    )
+    assert out["verdict"] == "healthy", out
+    # Gate off: no stream verdict at all.
+    with override_slo_stream_cadence_x(0.0):
+        out = evaluate_records([dict(rec)], now=now)
+    assert out["verdict"] == "healthy", out
+    assert out["thresholds"]["stream_cadence_x"] is None
+
+
+# --------------------------------------------------------------------------
+# Satellite units: chain-report + post-mortem rendering
+# --------------------------------------------------------------------------
+
+
+def test_chain_report_renders_world_and_degraded(capsys):
+    from tpusnap.__main__ import _print_chain_report
+    from tpusnap.delta import ChainMember, DeltaChainReport
+
+    rep = DeltaChainReport(
+        root="/tmp/x",
+        members=[
+            ChainMember(
+                name="base-000000", state="committed", seq=0,
+                stream_id="s", world={"size": 2, "ranks": [0, 1]},
+            ),
+            ChainMember(
+                name="delta-000001", state="committed", seq=1,
+                parent="base-000000", stream_id="s",
+                world={"size": 1, "ranks": [0], "left": [1]},
+                degraded={
+                    "dead_ranks": [1], "live_ranks": [0],
+                    "adopted_units": ["u1", "u2"], "adopters": {"u1": 0},
+                },
+            ),
+            ChainMember(
+                name="delta-000002", state="torn", seq=2,
+                parent="delta-000001", stream_id="s",
+                world={"size": 2, "ranks": [0, 1]}, missing_ranks=[1],
+            ),
+        ],
+        head="delta-000001",
+        torn_tail="delta-000002",
+        chain=["delta-000001", "base-000000"],
+    )
+    _print_chain_report(rep)
+    out = capsys.readouterr().out
+    assert "world 2 (ranks [0, 1])" in out
+    assert "left [1]" in out
+    assert "DEGRADED: rank(s) [1] died mid-epoch; 2 unit(s) adopted" in out
+    assert "journal evidence missing from global rank(s) [1]" in out
+    assert "DEGRADED" in rep.summary()
+    assert "missing journal evidence from rank(s) [1]" in rep.summary()
+
+
+def test_postmortem_renders_left_ranks(capsys):
+    from tpusnap.__main__ import _render_verdict
+
+    _render_verdict(
+        {
+            "state": "committed",
+            "ranks": {},
+            "left_ranks": [1],
+            "dead_ranks": None,
+        }
+    )
+    out = capsys.readouterr().out
+    assert "LEFT rank(s) [1]" in out
+    assert "GRACEFULLY" in out
+    assert "DEAD" not in out

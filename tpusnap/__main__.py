@@ -203,6 +203,38 @@ def _print_chain_report(rep) -> int:
             f"  {mark}  {m.name:<16s} {m.state:<10s} {seq:<8s} "
             f"{_fmt_bytes(m.payload_bytes):>10s}  {when}"
         )
+        # Elastic-stream forensics: the participating world of the
+        # epoch (size + joins/leaves vs the previous epoch), degraded
+        # commits (who died, who adopted), and — for a torn multi-rank
+        # epoch — whose journal evidence is missing.
+        bits = []
+        w = m.world
+        if w and w.get("size"):
+            b = f"world {w['size']} (ranks {w.get('ranks')})"
+            if w.get("joined"):
+                b += f", joined {w['joined']}"
+            if w.get("left"):
+                b += f", left {w['left']}"
+            if w.get("expired"):
+                b += f", expired {w['expired']}"
+            bits.append(b)
+        if m.degraded:
+            adopters = sorted(
+                set((m.degraded.get("adopters") or {}).values())
+            )
+            bits.append(
+                f"DEGRADED: rank(s) {m.degraded.get('dead_ranks')} died "
+                f"mid-epoch; "
+                f"{len(m.degraded.get('adopted_units') or [])} unit(s) "
+                f"adopted by survivor(s) {adopters}"
+            )
+        if m.state == "torn" and m.missing_ranks:
+            bits.append(
+                "journal evidence missing from global rank(s) "
+                f"{m.missing_ranks}"
+            )
+        for b in bits:
+            print(f"        {b}")
     if rep.head:
         print(f"recovery:    restore {rep.head_path} "
               f"(replays {' + '.join(reversed(rep.chain))})")
@@ -1133,6 +1165,13 @@ def _render_verdict(verdict: dict) -> None:
             "flush, a non-local destination, or the host died with its "
             "telemetry dir"
         )
+    left = verdict.get("left_ranks")
+    if left:
+        print(
+            f"  LEFT rank(s) {left}: departed GRACEFULLY (terminal "
+            "'left' lease/membership state) — not a failure; the "
+            "remaining ranks re-planned without them"
+        )
     dead = verdict.get("dead_ranks")
     if dead:
         print(
@@ -1522,7 +1561,9 @@ def cmd_slo(args) -> int:
             "thresholds: "
             f"rpo={'%gs' % th['rpo_s'] if th['rpo_s'] else 'unset'} "
             f"rto={'%gs' % th['rto_s'] if th['rto_s'] else 'unset'} "
-            "(TPUSNAP_SLO_RPO_S / TPUSNAP_SLO_RTO_S, or --rpo/--rto)"
+            f"stream={'%gx cadence' % th['stream_cadence_x'] if th.get('stream_cadence_x') else 'off'} "
+            "(TPUSNAP_SLO_RPO_S / TPUSNAP_SLO_RTO_S / "
+            "TPUSNAP_SLO_STREAM_CADENCE_X)"
         )
         if report["ranks"]:
             print(
@@ -1532,8 +1573,11 @@ def cmd_slo(args) -> int:
             for r in report["ranks"]:
                 flags = [
                     k
-                    for k, on in (("RPO", r["breach_rpo"]),
-                                  ("RTO", r["breach_rto"]))
+                    for k, on in (
+                        ("RPO", r["breach_rpo"]),
+                        ("RTO", r["breach_rto"]),
+                        ("STREAM", r.get("breach_stream")),
+                    )
                     if on
                 ]
                 rto = r.get("estimated_rto_s")
@@ -1575,7 +1619,8 @@ def cmd_slo(args) -> int:
                 print(
                     f"stream:     delta stream active, cadence {cadence:g}s "
                     "— micro-commits anchor the RPO (expect since-commit "
-                    "≤ ~2x cadence)"
+                    "≤ ~2x cadence; --check exits 2 past the stream "
+                    "threshold)"
                 )
             if any(not r.get("committed") for r in report["ranks"]):
                 print("(* = no commit yet; exposure counted from tracker start)")

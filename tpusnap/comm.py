@@ -425,6 +425,67 @@ class JaxCoordinationComm(Communicator):
         return result
 
 
+class SubsetComm(JaxCoordinationComm):
+    """Collectives over a SUBSET of the jax.distributed world — the
+    communicator elastic delta streams run their per-epoch captures on
+    (:mod:`tpusnap.delta`): after a rank dies or leaves, the survivors
+    keep taking real multi-rank snapshots without it, and a joiner is
+    folded in at the next epoch simply by listing it as a member.
+
+    The subset is expressed by RE-RANKING: ``rank``/``world_size``
+    report this process's position within ``members`` (sorted global
+    process ids), so every loop the parent class runs over
+    ``range(world_size)`` — arrive keys, gather slots, leader checks —
+    stays correct verbatim. The GLOBAL identity survives as
+    ``global_rank``/``global_ranks`` for rendering and forensics (take
+    internals — leases, journals, manifests — speak virtual ranks; the
+    epoch metadata maps them back).
+
+    Two contract changes against the parent:
+
+    - the namespace is REQUIRED and must be identical (and unique per
+      epoch) on every member — the lazy auto-counter cannot agree
+      across processes that construct different numbers of
+      communicators once the world diverges;
+    - barriers always use the KV polling path: the coordination
+      service's native ``wait_at_barrier`` counts every process in the
+      job, which would park a subset barrier until the full-world
+      timeout.
+    """
+
+    def __init__(
+        self,
+        members: List[int],
+        namespace: str,
+        timeout_ms: Optional[int] = None,
+    ) -> None:
+        super().__init__(timeout_ms=timeout_ms, namespace=namespace)
+        self.global_rank = self._rank
+        self.global_ranks = sorted(int(m) for m in members)
+        if len(set(self.global_ranks)) != len(self.global_ranks):
+            raise ValueError(f"duplicate members: {members}")
+        if self.global_rank not in self.global_ranks:
+            raise ValueError(
+                f"process {self.global_rank} is not a member of {members}"
+            )
+        self._rank = self.global_ranks.index(self.global_rank)
+        self._world_size = len(self.global_ranks)
+
+    def _barrier_impl(self) -> None:
+        from . import flight
+
+        seq = self._next_seq()
+        anchor = f"{self._namespace()}/b{seq}"
+        flight.record("barrier_enter", op=anchor)
+        prefix = self._polling_barrier(seq)
+        flight.record("barrier_exit", op=anchor)
+        # Same GC ordering as the parent's watched branch: flush proved
+        # prefixes BEFORE registering this barrier's own.
+        self._flush_gc()
+        with self._gc_lock:
+            self._gc_pending.append(prefix + "/")
+
+
 def _sanitize_ns(ns: str) -> str:
     """Escape everything outside [A-Za-z0-9_-]: keeps user namespaces
     from colliding with each other or with key/barrier separators."""

@@ -56,15 +56,42 @@ contract, streamed):
   :meth:`DeltaStream.close` stops the stream (with a final commit by
   default).
 
-Multi-process streams are not yet supported (cadence agreement and
-background state_dict capture across ranks need their own coordination
-protocol); ``world_size > 1`` raises. Single-process covers the
-serving/fine-tune fleets this mode targets first; multi-host training
-keeps explicit ``take``/``async_take``.
+Multi-process streams are **elastic**: the minimum joined rank — the
+*driver* — announces each capture epoch over the jax.distributed
+coordination KV; every member polls for the announcement and joins the
+epoch's collective micro-commit over a fresh per-epoch
+:class:`~tpusnap.comm.SubsetComm`, so each micro-commit is a real
+multi-rank incremental take riding the unchanged journal /
+metadata-written-last machinery, with the participating world recorded
+in ``extras["delta"]["world"]`` (and in the take journal, so a torn
+epoch still names its world). Death and resize are stream events, not
+wedges:
+
+- a rank dying mid-epoch (lease expiry → ``RankFailedError``) lets the
+  survivors complete the epoch DEGRADED when every leaf is replicated
+  (the PR 15 degraded-commit protocol, extended to the stream's
+  force-clone-staged incremental async takes via ``stream_capture``);
+  the dead rank is expired from the membership and streaming continues;
+- sharded state refuses adoption: the torn epoch aborts (its salvage
+  substrate kept) and the stream **pauses** —
+  :attr:`DeltaStream.paused` / ``pause_info`` name the event; reopening
+  ``Snapshot.stream`` on the root resumes the committed chain and the
+  retake of the torn member salvages its journal-proven blobs;
+- ranks leave gracefully via :meth:`DeltaStream.leave` (a terminal
+  ``left`` member/lease state — watchers render LEFT, never DEAD) and
+  join a LIVE stream by calling ``Snapshot.stream`` on the same root;
+  either way the next capture boundary re-plans the world through the
+  take's own partitioner/resharding machinery.
+
+Reopening a stream root after full shutdown resumes the committed
+chain in place (single- and multi-process alike): the new stream
+adopts the head's stream id and sequence, takes no new base, and its
+first micro-commit retakes — and salvages — any torn tail.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import posixpath
 import threading
@@ -79,6 +106,15 @@ from .comm import Communicator, get_communicator
 from .knobs import get_delta_cadence_s, get_delta_max_chain
 
 logger = logging.getLogger(__name__)
+
+# Coordination-KV namespace of the elastic-stream control plane:
+# `tpusnap_stream/<stream_id>/members/<rank>` membership records,
+# `tpusnap_stream/<stream_id>/ann/<inc>/<epoch>` capture-epoch
+# announcements, `tpusnap_stream_root/<digest(root)>` the root
+# registration a joiner reads.
+_STREAM_KV_ROOT = "tpusnap_stream"
+# Follower poll interval for the next epoch announcement.
+_ANN_POLL_S = 0.1
 
 __all__ = [
     "DeltaStream",
@@ -138,6 +174,17 @@ class ChainMember:
     stream_id: Optional[str] = None
     created_at: Optional[float] = None
     payload_bytes: int = 0
+    # Elastic-stream forensics (multi-process epochs). ``world`` is the
+    # participating world recorded at capture time
+    # (``{"size", "ranks", "joined"?, "left"?, "expired"?}`` with
+    # GLOBAL process ids); ``degraded`` is the ``extras["degraded"]``
+    # record of an epoch the survivors completed without a dead rank;
+    # ``missing_ranks`` (torn members only) names the GLOBAL ranks
+    # whose per-rank journal evidence never landed — the write the tear
+    # interrupted.
+    world: Optional[Dict[str, Any]] = None
+    degraded: Optional[Dict[str, Any]] = None
+    missing_ranks: Optional[List[int]] = None
 
 
 @dataclass
@@ -177,8 +224,19 @@ class DeltaChainReport:
         )
         if self.chain:
             s += f", chain depth {len(self.chain)}"
+        degraded = [m for m in self.members if m.degraded]
+        if degraded:
+            s += f", {len(degraded)} DEGRADED epoch(s)"
         if self.torn_tail:
             s += f", TORN TAIL {self.torn_tail} (recovery ignores it)"
+            torn_m = next(
+                (m for m in self.members if m.name == self.torn_tail), None
+            )
+            if torn_m is not None and torn_m.missing_ranks:
+                s += (
+                    f" — missing journal evidence from rank(s) "
+                    f"{torn_m.missing_ranks}"
+                )
         if self.superseded:
             s += f", {len(self.superseded)} superseded"
         if self.debris:
@@ -239,6 +297,12 @@ def resolve_chain(
                         m.seq = d.get("seq")
                         m.parent = d.get("parent")
                         m.stream_id = d.get("stream")
+                        w = d.get("world")
+                        if isinstance(w, dict):
+                            m.world = w
+                    deg = (md.extras or {}).get("degraded")
+                    if isinstance(deg, dict):
+                        m.degraded = deg
                     try:
                         m.payload_bytes = delta_payload_bytes(md)
                     except Exception:
@@ -259,6 +323,32 @@ def resolve_chain(
                             m.seq = j.stream.get("seq")
                             m.parent = j.stream.get("parent")
                             m.stream_id = j.stream.get("stream")
+                            w = j.stream.get("world")
+                            if isinstance(w, dict):
+                                m.world = w
+                                ranks = w.get("ranks")
+                                if isinstance(ranks, list) and ranks:
+                                    # Per-rank journal evidence present
+                                    # under the torn member: a VIRTUAL
+                                    # rank with no record file never
+                                    # proved a single blob — name it by
+                                    # its GLOBAL id.
+                                    have = set()
+                                    rec_pfx = JOURNAL_RECORDS_DIR + "/rank_"
+                                    for p in sub:
+                                        if p.startswith(rec_pfx):
+                                            try:
+                                                have.add(
+                                                    int(p.rsplit("_", 1)[-1])
+                                                )
+                                            except ValueError:
+                                                pass
+                                    missing = [
+                                        int(ranks[v])
+                                        for v in range(len(ranks))
+                                        if v not in have
+                                    ]
+                                    m.missing_ranks = missing or None
                     except Exception:
                         pass
                 else:
@@ -342,14 +432,6 @@ class DeltaStream:
         max_chain: Optional[int] = None,
     ) -> None:
         comm = get_communicator(comm)
-        if comm.world_size > 1:
-            raise NotImplementedError(
-                "Snapshot.stream is single-process for now: multi-rank "
-                "micro-commit cadence agreement and background state "
-                "capture need their own coordination protocol. Use "
-                "take/async_take with incremental_from for multi-host "
-                "delta checkpointing."
-            )
         self.root = root
         if cadence_s is not None:
             cadence_s = float(cadence_s)
@@ -369,9 +451,14 @@ class DeltaStream:
         self._replicated = replicated
         self._storage_options = storage_options
         self._comm = comm
+        self._multi = comm.world_size > 1
+        self._rank = comm.rank  # GLOBAL process id
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._closed = False
+        self._leaving = False  # graceful departure in progress (multi)
+        self._paused = False  # torn epoch on rank failure (multi)
+        self._pause_info: Optional[Dict[str, Any]] = None
         self._seq = 0
         self._head: Optional[str] = None  # member NAME
         self._chain: List[str] = []  # oldest first, head last
@@ -385,6 +472,12 @@ class DeltaStream:
         # training thread never blocks past the staging window.
         self._pending_finalize: Optional[Dict[str, Any]] = None
         self._observability_stopped = False
+        # Multi-process control plane (all no-ops when world_size == 1).
+        self._kv = None
+        self._inc = ""  # per-open incarnation token (epoch key scope)
+        self._epoch = 1  # next epoch this rank expects to run
+        self._members: List[int] = [self._rank]  # last epoch's world
+        self._nudge_seen: Optional[bytes] = None
         self.stats: Dict[str, Any] = {
             "commits": 0,
             "bytes_written_total": 0,
@@ -393,35 +486,27 @@ class DeltaStream:
             "max_commit_interval_s": None,
             "compactions": 0,
             "steps_marked": 0,
+            "epochs": 0,
+            "degraded_epochs": 0,
+            "joins": 0,
+            "leaves": 0,
         }
 
-        # Refuse a root that already holds stream members: a fresh
-        # base-000000 under committed deltas that reference the OLD
-        # base would silently change the bytes their external
-        # references resolve to. Recovery is explicit — restore
-        # resolve_chain(root).head, then stream to a fresh root.
-        # (Backends that cannot list skip the guard.)
-        existing = resolve_chain(root, storage_options)
-        if existing.members:
-            raise ValueError(
-                f"{root!r} already holds delta-stream member(s) "
-                f"({', '.join(m.name for m in existing.members[:4])}"
-                f"{', ...' if len(existing.members) > 4 else ''}). "
-                "Resuming a stream in place is not supported: restore "
-                f"the recovery head ({existing.head!r}) into your app "
-                "state, then open the stream on a FRESH root (or gc the "
-                "old members first)."
-            )
+        if self._multi:
+            from .snapshot import _get_kv_store
 
-        # The base: a full, committed snapshot with per-tile dedup
-        # hashes recorded, so the very first increment already skips at
-        # tile grain. Synchronous — the stream is not armed until a
-        # recovery point exists.
-        flight.record(
-            "delta", op="stream_start", stream=self.stream_id,
-            cadence_s=self.cadence_s,
-        )
-        self._commit(kind="base")
+            self._kv = _get_kv_store(comm)
+            reg = self._read_reg()
+            if reg and reg.get("live"):
+                # A live stream already runs on this root: JOIN it solo
+                # (no collectives — the incumbents are mid-cadence, not
+                # at our call site).
+                self._open_join(reg)
+            else:
+                self._open_collective()
+        else:
+            self._open_solo()
+
         try:
             from . import slo as _slo
 
@@ -433,6 +518,146 @@ class DeltaStream:
             target=self._run, name="tpusnap-delta", daemon=True
         )
         self._worker.start()
+
+    # ----------------------------------------------------------- open paths
+
+    def _plan_open(self) -> Dict[str, Any]:
+        """Classify the root: FRESH (no committed chain — new stream id,
+        base now; a torn base-000000 is retaken in place, salvaging its
+        journal-proven blobs) or RESUME (committed chain present — adopt
+        its identity and head; the first micro-commit retakes — and
+        salvages — any torn tail). Committed members that are NOT chain
+        members keep the historical refusal: a fresh base under foreign
+        snapshots would silently change what the directory means."""
+        existing = resolve_chain(self.root, self._storage_options)
+        committed = [m for m in existing.members if m.state == "committed"]
+        if not committed:
+            return {
+                "resume": False,
+                "sid": self.stream_id,
+                "seq": 0,
+                "head": None,
+                "chain": [],
+                "torn": existing.torn_tail,
+            }
+        head_m = next(
+            (m for m in existing.members if m.name == existing.head), None
+        )
+        if head_m is None or head_m.seq is None or not head_m.stream_id:
+            raise ValueError(
+                f"{self.root!r} already holds committed non-stream "
+                f"snapshot(s) ({', '.join(m.name for m in committed[:4])}"
+                f"{', ...' if len(committed) > 4 else ''}). A delta "
+                "stream cannot adopt them: open the stream on a FRESH "
+                "root (or gc the old members first)."
+            )
+        return {
+            "resume": True,
+            "sid": head_m.stream_id,
+            "seq": int(head_m.seq),
+            "head": existing.head,
+            "chain": list(reversed(existing.chain)),
+            "torn": existing.torn_tail,
+        }
+
+    def _apply_plan(self, plan: Dict[str, Any]) -> None:
+        self.stream_id = plan["sid"]
+        self._seq = int(plan["seq"])
+        self._head = plan["head"]
+        self._chain = list(plan["chain"])
+        if plan["resume"]:
+            # The caller restored the head before reopening (or is
+            # about to diverge from it knowingly); the stream is armed
+            # on the EXISTING recovery point — no new base.
+            self._last_commit_mono = time.monotonic()
+            telemetry.incr("delta.stream_resumes")
+            flight.record(
+                "delta",
+                op="stream_resume",
+                stream=self.stream_id,
+                head=self._head,
+                seq=self._seq,
+                torn_tail=plan.get("torn"),
+            )
+            logger.info(
+                "Resuming delta stream %s at %r: head %s (seq %d)%s",
+                self.stream_id,
+                self.root,
+                self._head,
+                self._seq,
+                (
+                    f"; torn tail {plan['torn']} will be salvaged on "
+                    "the next micro-commit"
+                    if plan.get("torn")
+                    else ""
+                ),
+            )
+
+    def _open_solo(self) -> None:
+        plan = self._plan_open()
+        self._apply_plan(plan)
+        flight.record(
+            "delta", op="stream_start", stream=self.stream_id,
+            cadence_s=self.cadence_s,
+        )
+        if not plan["resume"]:
+            # The base: a full, committed snapshot with per-tile dedup
+            # hashes recorded, so the very first increment already
+            # skips at tile grain. Synchronous — the stream is not
+            # armed until a recovery point exists.
+            self._commit(kind="base")
+
+    def _open_collective(self) -> None:
+        """Full-world open: rank 0 resolves the root (fresh vs resume)
+        and broadcasts ONE plan — every rank must enter together,
+        exactly like any SPMD cold start."""
+        plan = None
+        if self._rank == 0:
+            plan = self._plan_open()
+            plan["inc"] = uuid.uuid4().hex[:8]
+        plan = self._comm.broadcast_object(plan, src=0)
+        self._apply_plan(plan)
+        self._inc = plan["inc"]
+        self._members = list(range(self._comm.world_size))
+        self._epoch = 1
+        # Membership + root registration BEFORE the base take, so a
+        # joiner arriving mid-base already sees a live stream.
+        self._set_member_state("joined")
+        if self._rank == 0:
+            self._write_reg(live=True)
+        flight.record(
+            "delta", op="stream_start", stream=self.stream_id,
+            cadence_s=self.cadence_s, world=len(self._members),
+        )
+        if not plan["resume"]:
+            self._commit(kind="base")
+
+    def _open_join(self, reg: Dict[str, Any]) -> None:
+        """Join a LIVE stream on this root: adopt the advertised
+        identity, record membership, and participate from the first
+        epoch whose announcement lists this rank. No collectives, no
+        base — the chain already has one."""
+        self.stream_id = reg["sid"]
+        self._inc = reg.get("inc", "")
+        if reg.get("cadence_s"):
+            self.cadence_s = float(reg["cadence_s"])
+        self._seq = int(reg.get("seq", 0))
+        self._head = reg.get("head")
+        self._chain = [self._head] if self._head else []
+        self._epoch = int(reg.get("epoch", 0)) + 1
+        self._members = []
+        self._last_commit_mono = time.monotonic()
+        self._set_member_state("joined")
+        self.stats["joins"] += 1
+        telemetry.incr("delta.stream_joins")
+        flight.record(
+            "delta", op="stream_join", stream=self.stream_id,
+            rank=self._rank, epoch=self._epoch,
+        )
+        logger.info(
+            "rank %d joining live delta stream %s at %r (next epoch %d)",
+            self._rank, self.stream_id, self.root, self._epoch,
+        )
 
     # ------------------------------------------------------------- public
 
@@ -524,9 +749,15 @@ class DeltaStream:
                         self._cv.notify_all()
 
     def commit_now(self):
-        """Force a synchronous micro-commit on the calling thread and
-        return the committed :class:`~tpusnap.Snapshot`. Raises if the
-        stream is closed."""
+        """Force a micro-commit and return the committed
+        :class:`~tpusnap.Snapshot`. Raises if the stream is closed.
+        Single-process: runs synchronously on the calling thread.
+        Multi-process: nudges the driver to announce the next epoch
+        immediately and blocks until this rank's worker has committed
+        it — commits are collective, so they always run on the epoch
+        protocol, never inline on one rank."""
+        if self._multi:
+            return self._commit_now_multi()
         with self._cv:
             if self._closed:
                 raise RuntimeError("DeltaStream is closed")
@@ -543,11 +774,75 @@ class DeltaStream:
                 self._capture_busy = False
                 self._cv.notify_all()
 
+    def _commit_now_multi(self):
+        from .snapshot import Snapshot
+
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("DeltaStream is closed")
+            target = self.stats["commits"] + 1
+        try:
+            self._kv.set(
+                f"{self._kv_prefix()}/nudge", uuid.uuid4().hex.encode()
+            )
+        except Exception:
+            logger.warning("commit_now nudge failed", exc_info=True)
+        deadline = time.monotonic() + max(60.0, 4.0 * self.cadence_s)
+        with self._cv:
+            while self.stats["commits"] < target:
+                if self._closed:
+                    if self._paused:
+                        raise RuntimeError(
+                            f"DeltaStream is paused: {self._pause_info}"
+                        )
+                    err = self._last_error
+                    if err is not None:
+                        raise RuntimeError(
+                            "DeltaStream worker failed during commit_now"
+                        ) from err
+                    raise RuntimeError("DeltaStream is closed")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "commit_now timed out waiting for the stream epoch"
+                    )
+                self._cv.wait(timeout=0.25)
+            head = self._member_path(self._head)
+        return Snapshot(head, self._storage_options)
+
     def close(self, final_commit: bool = True) -> Optional[str]:
         """Stop the stream. With ``final_commit`` (the default) a last
         micro-commit captures the state as of close, so nothing since
         the previous cadence tick is lost. Returns the head path.
-        Idempotent."""
+        Idempotent.
+
+        Multi-process close is a graceful :meth:`leave` — elastic
+        membership can't promise every member is at a close() call
+        site, so there is no implicit final collective commit; call
+        :meth:`commit_now` first for an at-close recovery point. The
+        last member out turns the root registration off so a later
+        full-world open resumes from storage."""
+        if self._multi:
+            with self._lock:
+                already = self._closed
+            if (
+                not already
+                and final_commit
+                and self._last_error is None
+                and not self._paused
+            ):
+                logger.info(
+                    "multi-process DeltaStream close takes no implicit "
+                    "final commit; call commit_now() first for an "
+                    "at-close recovery point"
+                )
+            head = self.leave()
+            try:
+                states = self._read_members()
+                if not any(s == "joined" for s in states.values()):
+                    self._write_reg(live=False)
+            except Exception:
+                pass
+            return head
         with self._cv:
             already = self._closed
             if not already:
@@ -612,10 +907,87 @@ class DeltaStream:
         # honest recovery point.
         self.close(final_commit=exc_type is None)
 
+    def leave(self) -> Optional[str]:
+        """Gracefully leave a multi-process stream: finish any epoch
+        this rank is already announced into, publish a terminal
+        ``left`` membership state (watchers render LEFT, never DEAD —
+        no ``RankFailedError``, no degraded epoch), and stop this
+        rank's worker. The remaining members re-plan the next capture
+        boundary without this rank; it can rejoin later by reopening
+        ``Snapshot.stream`` on the same root. On a single-process
+        stream this is ``close(final_commit=False)``. Returns the last
+        head path this rank observed. Idempotent."""
+        if not self._multi:
+            return self.close(final_commit=False)
+        with self._cv:
+            if self._closed:
+                return self._member_path(self._head) if self._head else None
+            if self._leaving:
+                already_leaving = True
+            else:
+                already_leaving = False
+                self._leaving = True
+                self._cv.notify_all()
+        if not already_leaving:
+            # Publish the departure FIRST: the driver re-reads
+            # membership immediately before announcing, so no NEW epoch
+            # lists this rank after this write. An epoch ALREADY
+            # announced with us in its world is honored by the worker
+            # before it exits (the _leaving checks in the epoch loop).
+            self._set_member_state("left")
+            self.stats["leaves"] += 1
+            telemetry.incr("delta.stream_leaves")
+            flight.record("rank_left", rank=self._rank)
+            flight.record(
+                "delta", op="stream_leave", stream=self.stream_id,
+                rank=self._rank, epoch=self._epoch,
+            )
+        from .io_types import close_may_join
+
+        if close_may_join():
+            # Same join gate as close(): a GC-finalizer leave must not
+            # block; the daemon worker observes _leaving and exits.
+            # tpusnap: waive=TPS006 join is gated on close_may_join() above
+            self._worker.join(timeout=120.0)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._stop_observability()
+        logger.info(
+            "rank %d left delta stream %s", self._rank, self.stream_id
+        )
+        return self._member_path(self._head) if self._head else None
+
+    @property
+    def paused(self) -> bool:
+        """True when a torn epoch paused the stream (rank failure the
+        survivors could not degrade). A paused stream is a NAMED,
+        policy-handled event, not a worker failure —
+        :meth:`raise_if_failed` stays silent; ``pause_info`` carries
+        the forensics. Reopen ``Snapshot.stream`` on the root to
+        resume (the torn member salvages on the retake)."""
+        with self._lock:
+            return self._paused
+
+    @property
+    def pause_info(self) -> Optional[Dict[str, Any]]:
+        """``{"epoch", "member", "dead_ranks", "detail"}`` of the torn
+        epoch that paused the stream, or None."""
+        with self._lock:
+            return dict(self._pause_info) if self._pause_info else None
+
+    @property
+    def members(self) -> List[int]:
+        """GLOBAL ranks of the last completed epoch's world (this
+        process alone for single-process streams)."""
+        with self._lock:
+            return list(self._members)
+
     def raise_if_failed(self) -> None:
         """Re-raise the worker's terminal failure, if any (a failed
         micro-commit stops the stream rather than silently shipping
-        stale recovery points forever)."""
+        stale recovery points forever). A PAUSED stream does not raise
+        — check :attr:`paused`."""
         with self._lock:
             err = self._last_error
         if err is not None:
@@ -628,6 +1000,388 @@ class DeltaStream:
 
     def _member_path(self, name: str) -> str:
         return f"{self.root.rstrip('/')}/{name}"
+
+    # --------------------------------------------- multi-process control KV
+
+    def _kv_prefix(self) -> str:
+        return f"{_STREAM_KV_ROOT}/{self.stream_id}"
+
+    def _member_key(self, rank: int) -> str:
+        return f"{self._kv_prefix()}/members/{rank}"
+
+    def _ann_key(self, epoch: int) -> str:
+        return f"{self._kv_prefix()}/ann/{self._inc}/{epoch}"
+
+    def _reg_key(self) -> str:
+        import hashlib
+
+        digest = hashlib.sha1(
+            self.root.rstrip("/").encode("utf-8")
+        ).hexdigest()[:16]
+        return f"{_STREAM_KV_ROOT}_root/{digest}"
+
+    def _read_reg(self) -> Optional[Dict[str, Any]]:
+        try:
+            raw = self._kv.try_get(self._reg_key())
+            return None if raw is None else json.loads(raw.decode("utf-8"))
+        except Exception:
+            return None
+
+    def _write_reg(self, live: bool) -> None:
+        """Root registration: what a later ``Snapshot.stream`` on the
+        same root reads to decide join-live vs collective open. Updated
+        by the driver after every epoch (so a joiner adopts a current
+        head), turned off at pause and by the last member out."""
+        try:
+            self._kv.set(
+                self._reg_key(),
+                json.dumps(
+                    {
+                        "sid": self.stream_id,
+                        "inc": self._inc,
+                        "live": bool(live),
+                        "cadence_s": self.cadence_s,
+                        "epoch": self._epoch - 1,
+                        "seq": self._seq,
+                        "head": self._head,
+                    }
+                ).encode("utf-8"),
+            )
+        except Exception:
+            logger.debug("stream reg write failed", exc_info=True)
+
+    def _set_member_state(self, state: str, rank: Optional[int] = None) -> None:
+        try:
+            self._kv.set(
+                self._member_key(self._rank if rank is None else rank),
+                json.dumps({"state": state, "epoch": self._epoch}).encode(
+                    "utf-8"
+                ),
+            )
+        except Exception:
+            logger.warning(
+                "stream membership write (%s) failed", state, exc_info=True
+            )
+
+    def _read_members(self) -> Dict[int, str]:
+        """GLOBAL rank -> membership state (joined/left/expired)."""
+        out: Dict[int, str] = {}
+        blobs = None
+        try:
+            blobs = self._kv.try_get_dir(f"{self._kv_prefix()}/members/")
+        except Exception:
+            blobs = None
+        if blobs is None:
+            # Per-rank probe fallback, bounded: the jax world is the
+            # superset of every possible member.
+            blobs = {}
+            for r in range(self._comm.world_size):
+                raw = self._kv.try_get(self._member_key(r))
+                if raw is not None:
+                    blobs[str(r)] = raw
+        for key, raw in blobs.items():
+            try:
+                r = int(key.rsplit("/", 1)[-1])
+                out[r] = json.loads(raw.decode("utf-8")).get(
+                    "state", "joined"
+                )
+            except Exception:
+                continue
+        return out
+
+    def _joined_members(self) -> List[int]:
+        membership = self._read_members()
+        members = sorted(
+            r for r, s in membership.items() if s == "joined"
+        )
+        if self._rank not in members:
+            members = sorted(set(members) | {self._rank})
+        return members
+
+    def _nudged(self) -> bool:
+        """A commit_now caller (any member) wants the next epoch NOW."""
+        try:
+            raw = self._kv.try_get(f"{self._kv_prefix()}/nudge")
+        except Exception:
+            return False
+        if raw is not None and raw != self._nudge_seen:
+            self._nudge_seen = raw
+            return True
+        return False
+
+    def _takeover_grace(self) -> float:
+        # How long a follower waits past the cadence before presuming
+        # the driver dead: several lease TTLs (death detection would
+        # have fired inside any in-flight epoch long before), staggered
+        # by rank so takeovers don't herd.
+        from .knobs import get_liveness_ttl_s
+
+        ttl = get_liveness_ttl_s()
+        return max(4.0 * ttl, 10.0) + 0.5 * self._rank
+
+    # ------------------------------------------------- multi-process epochs
+
+    def _run_multi(self) -> None:
+        """Elastic epoch loop. The driver — the minimum currently-joined
+        global rank — announces each capture epoch over the
+        coordination KV; every member polls for the announcement and
+        joins the epoch's collective micro-commit over a per-epoch
+        :class:`~tpusnap.comm.SubsetComm`. Membership is re-read at
+        every announcement, so leaves (graceful or expired) and joins
+        re-plan the world at the next capture boundary."""
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+            members = self._joined_members()
+            try:
+                if min(members) == self._rank:
+                    alive = self._drive_one_epoch()
+                else:
+                    alive = self._follow_one_epoch(min(members))
+            except Exception as e:  # defensive: never wedge the worker
+                self._fail(e, where="elastic epoch loop")
+                return
+            if not alive:
+                return
+
+    def _drive_one_epoch(self) -> bool:
+        # Cadence wait, interruptible by close/leave and commit_now
+        # nudges (the nudge key is polled, not pushed — the KV has no
+        # watch primitive).
+        deadline = self._last_commit_mono + self.cadence_s
+        while True:
+            with self._cv:
+                if self._closed:
+                    return False
+                if self._leaving:
+                    return False
+            if self._nudged():
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            with self._cv:
+                self._cv.wait(timeout=min(remaining, 0.25))
+        membership = self._read_members()
+        members = sorted(
+            r for r, s in membership.items() if s == "joined"
+        )
+        if self._rank not in members:
+            members = sorted(set(members) | {self._rank})
+        if min(members) != self._rank:
+            # A lower rank (re)joined; it drives from here.
+            return True
+        prev = set(self._members)
+        world: Dict[str, Any] = {"size": len(members), "ranks": members}
+        joins = sorted(set(members) - prev)
+        leaves = sorted(prev - set(members))
+        if joins:
+            world["joined"] = joins
+        if leaves:
+            world["left"] = leaves
+            expired = [r for r in leaves if membership.get(r) == "expired"]
+            if expired:
+                world["expired"] = expired
+        ann = {
+            "epoch": self._epoch,
+            "seq": self._seq + 1,
+            "parent": self._head,
+            "members": members,
+            "world": world,
+        }
+        self._kv.set(
+            self._ann_key(self._epoch),
+            json.dumps(ann).encode("utf-8"),
+        )
+        return self._run_epoch(ann)
+
+    def _follow_one_epoch(self, driver: int) -> bool:
+        ann_key = self._ann_key(self._epoch)
+        takeover_at = (
+            time.monotonic() + self.cadence_s + self._takeover_grace()
+        )
+        leave_by: Optional[float] = None
+        while True:
+            with self._cv:
+                if self._closed:
+                    return False
+                leaving = self._leaving
+            if leaving and leave_by is None:
+                # A leaver must LINGER ~one cadence: the driver may have
+                # read membership just before our `left` write landed
+                # and announce an epoch that still names us — exiting
+                # now would strand it mid-gather. Any membership read
+                # after the write excludes us, so at most one such
+                # racing announcement exists; serve it if it arrives,
+                # then go.
+                leave_by = time.monotonic() + self.cadence_s + 2.0
+            raw = None
+            try:
+                raw = self._kv.try_get(ann_key)
+            except Exception:
+                pass
+            if raw is not None:
+                break
+            if leave_by is not None and time.monotonic() > leave_by:
+                # No racing announcement can still list us — done.
+                return False
+            if not leaving and time.monotonic() > takeover_at:
+                # The driver went a full cadence plus several lease
+                # TTLs without announcing: presume it dead BETWEEN
+                # epochs (an in-flight epoch's liveness would have
+                # caught it), expire it and let the next-lowest joined
+                # rank (possibly this one) drive.
+                self._set_member_state("expired", rank=driver)
+                flight.record(
+                    "delta", op="driver_takeover", stream=self.stream_id,
+                    expired=driver, by=self._rank, epoch=self._epoch,
+                )
+                logger.warning(
+                    "delta stream %s: driver rank %d silent past "
+                    "takeover grace; expiring it from the stream",
+                    self.stream_id, driver,
+                )
+                return True
+            with self._cv:
+                self._cv.wait(timeout=_ANN_POLL_S)
+        try:
+            ann = json.loads(raw.decode("utf-8"))
+        except Exception:
+            logger.warning("unparseable epoch announcement; skipping")
+            self._epoch += 1
+            return True
+        if self._rank not in ann.get("members", []):
+            # Announced before our join record landed: skip — the next
+            # epoch's membership read includes us. seq/head are adopted
+            # from the first announcement we DO participate in. A
+            # LEAVER seeing itself re-planned out is done for good.
+            self._epoch = int(ann["epoch"]) + 1
+            return not leaving
+        return self._run_epoch(ann)
+
+    def _run_epoch(self, ann: Dict[str, Any]) -> bool:
+        """One collective micro-commit over the announced member set.
+        Returns False when the stream must stop (close/pause/failure)."""
+        from .comm import SubsetComm
+        from .dist_store import TakeAbortedError
+        from .liveness import RankFailedError
+
+        members = [int(r) for r in ann["members"]]
+        epoch = int(ann["epoch"])
+        seq = int(ann["seq"])
+        with self._cv:
+            if self._closed:
+                return False
+            self._capture_busy = True
+        snap = None
+        try:
+            subset = SubsetComm(
+                members,
+                namespace=(
+                    f"tpusnap/st/{self.stream_id}-{self._inc}-e{epoch}"
+                ),
+            )
+            ctx = self._begin_capture(
+                "delta",
+                seq=seq,
+                parent=ann.get("parent"),
+                comm=subset,
+                world=ann.get("world")
+                or {"size": len(members), "ranks": members},
+            )
+            snap = self._finalize_capture(ctx)
+        except RankFailedError as e:
+            self._pause_on_rank_failure(e, ann)
+            return False
+        except TakeAbortedError as e:
+            if "RankFailedError" in str(e):
+                # A peer detected the death first and published the
+                # abort; same torn-epoch outcome on this rank.
+                self._pause_on_rank_failure(e, ann)
+            else:
+                self._fail(e, where=f"elastic micro-commit (epoch {epoch})")
+            return False
+        except BaseException as e:
+            self._fail(e, where=f"elastic micro-commit (epoch {epoch})")
+            return False
+        finally:
+            with self._cv:
+                self._capture_busy = False
+                self._cv.notify_all()
+        # Commit landed (possibly degraded — metadata says which).
+        self._members = members
+        self._epoch = epoch + 1
+        self.stats["epochs"] += 1
+        deg = (snap.metadata.extras or {}).get("degraded")
+        if deg:
+            dead_global = sorted(
+                members[v]
+                for v in deg.get("dead_ranks", [])
+                if 0 <= v < len(members)
+            )
+            self.stats["degraded_epochs"] += 1
+            telemetry.incr("delta.degraded_epochs")
+            for r in dead_global:
+                self._set_member_state("expired", rank=r)
+            flight.record(
+                "delta", op="degraded_epoch", stream=self.stream_id,
+                epoch=epoch, seq=seq, dead_ranks=dead_global,
+            )
+            logger.warning(
+                "delta stream %s epoch %d committed DEGRADED without "
+                "global rank(s) %s; they are expired from the stream "
+                "and the next capture re-plans around them",
+                self.stream_id, epoch, dead_global,
+            )
+        if min(members) == self._rank:
+            self._write_reg(live=True)
+        return True
+
+    def _pause_on_rank_failure(self, exc: BaseException, ann: Dict[str, Any]) -> None:
+        """A rank died mid-epoch and the survivors could not degrade
+        (sharded state cannot be adopted): the torn epoch keeps its
+        salvage substrate and the stream PAUSES — a named,
+        policy-handled event, not a worker failure. The committed chain
+        stays the recovery point; reopening ``Snapshot.stream`` on the
+        root resumes it and the retake salvages the torn member."""
+        members = [int(r) for r in ann["members"]]
+        ranks = getattr(exc, "ranks", None) or []
+        dead_global = sorted(
+            {members[v] for v in ranks if 0 <= v < len(members)}
+        )
+        member = member_name(int(ann["seq"]))
+        for r in dead_global:
+            self._set_member_state("expired", rank=r)
+        with self._cv:
+            self._paused = True
+            self._pause_info = {
+                "epoch": int(ann["epoch"]),
+                "member": member,
+                "dead_ranks": dead_global or None,
+                "detail": str(exc),
+            }
+            self._closed = True
+            self._cv.notify_all()
+        telemetry.incr("delta.stream_pauses")
+        flight.record(
+            "delta", op="stream_pause", stream=self.stream_id,
+            epoch=int(ann["epoch"]), member=member,
+            dead_ranks=dead_global or None,
+        )
+        self._write_reg(live=False)
+        logger.error(
+            "delta stream %s PAUSED: epoch %d (member %s) tore on rank "
+            "failure%s and could not commit degraded. The committed "
+            "chain is intact; reopen Snapshot.stream on %r after "
+            "recovery — the torn member salvages on the retake.",
+            self.stream_id,
+            int(ann["epoch"]),
+            member,
+            f" of global rank(s) {dead_global}" if dead_global else "",
+            self.root,
+        )
+        self._stop_observability()
 
     def _fail(self, exc: BaseException, where: str) -> None:
         """Stop the stream on a terminal failure (the last committed
@@ -671,7 +1425,14 @@ class DeltaStream:
         out their background commit drains), wake at cadence, capture
         here (free-running) or defer to the next mark_step (step-gated,
         with a one-cadence grace so a stalled training loop cannot
-        suspend checkpointing forever)."""
+        suspend checkpointing forever). Multi-process streams run the
+        elastic epoch loop instead — captures are announcement-driven
+        and always run here on the worker (the collective rendezvous
+        inside the take is the cross-rank step synchronizer; mark_step
+        still feeds stats and the SLO tracker)."""
+        if self._multi:
+            self._run_multi()
+            return
         while True:
             with self._cv:
                 ctx = self._pending_finalize
@@ -748,28 +1509,50 @@ class DeltaStream:
         (worker)."""
         return self._finalize_capture(self._begin_capture(kind))
 
-    def _begin_capture(self, kind: str) -> Dict[str, Any]:
+    def _begin_capture(
+        self,
+        kind: str,
+        *,
+        seq: Optional[int] = None,
+        parent: Optional[str] = None,
+        comm: Optional[Communicator] = None,
+        world: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
         """The capture half: state_dict + strict dual-hash staging.
         When this returns, the content is FROZEN (incremental takes
         stage everything before async_take returns) and the caller may
         mutate state again; the storage writes + two-phase commit drain
         on the take's own background thread. Caller holds the
-        _capture_busy slot (or is __init__)."""
+        _capture_busy slot (or is __init__).
+
+        Elastic epochs pass ``seq``/``parent`` from the announcement
+        (authoritative — a joiner's local view may lag), ``comm`` the
+        per-epoch :class:`~tpusnap.comm.SubsetComm`, and ``world`` the
+        participating world recorded into ``extras["delta"]`` (and
+        thus the take journal, so even a torn epoch names it)."""
         from .snapshot import Snapshot
 
         t0 = time.monotonic()
-        with self._lock:
-            seq = self._seq if kind == "base" else self._seq + 1
-            prev = self._head
+        if seq is None:
+            with self._lock:
+                seq = self._seq if kind == "base" else self._seq + 1
+                parent = self._head
+        take_comm = comm if comm is not None else self._comm
+        if world is None and self._multi:
+            world = {
+                "size": take_comm.world_size,
+                "ranks": sorted(self._members),
+            }
         name = member_name(seq)
         path = self._member_path(name)
-        extras = {
-            "delta": {
-                "stream": self.stream_id,
-                "seq": seq,
-                "parent": prev,
-            }
+        delta_extras: Dict[str, Any] = {
+            "stream": self.stream_id,
+            "seq": seq,
+            "parent": parent,
         }
+        if world:
+            delta_extras["world"] = world
+        extras = {"delta": delta_extras}
         ctx: Dict[str, Any] = {"kind": kind, "t0": t0, "seq": seq,
                                "name": name}
         if kind == "base":
@@ -779,7 +1562,7 @@ class DeltaStream:
                 self._app_state,
                 replicated=self._replicated,
                 storage_options=self._storage_options,
-                comm=self._comm,
+                comm=take_comm,
                 _extras=extras,
                 _record_dedup_hashes=True,
             )
@@ -797,11 +1580,15 @@ class DeltaStream:
                 self._app_state,
                 replicated=self._replicated,
                 storage_options=self._storage_options,
-                comm=self._comm,
-                incremental_from=self._member_path(prev),
+                comm=take_comm,
+                incremental_from=self._member_path(parent),
                 _extras=extras,
                 _record_dedup_hashes=True,
                 _force_clone_staging=True,
+                # Arms the degraded-commit context for this incremental
+                # async take (see the _take_impl gate): the force-clone
+                # staging above is exactly what makes adoption safe.
+                _stream_capture=True,
             )
         return ctx
 
@@ -843,6 +1630,8 @@ class DeltaStream:
                     st["max_commit_interval_s"] or 0.0, round(interval, 4)
                 )
             chain_len = len(self._chain)
+            # commit_now waiters (multi) watch stats["commits"].
+            self._cv.notify_all()
         flight.record(
             "delta",
             op="micro_commit" if kind != "base" else "base_commit",
@@ -852,7 +1641,18 @@ class DeltaStream:
             wall_s=round(wall, 4),
         )
         if chain_len > self.max_chain:
-            self._compact(snap)
+            if self._multi:
+                # Compaction (materialize + retire) is a single-writer
+                # job; with every member holding a handle it would
+                # race. Leave long multi-process chains to `tpusnap gc`
+                # or an explicit maintenance materialize.
+                logger.debug(
+                    "multi-process stream chain depth %d exceeds "
+                    "max_chain=%d; compaction is single-process only",
+                    chain_len, self.max_chain,
+                )
+            else:
+                self._compact(snap)
         return snap
 
     def _compact(self, head_snap) -> None:

@@ -103,7 +103,21 @@ class CoordinationKVStore(KVStore):
     def set(self, key: str, value: bytes) -> None:
         import base64
 
-        self._client.key_value_set(self._k(key), base64.b64encode(value).decode())
+        payload = base64.b64encode(value).decode()
+        try:
+            # Overwrite semantics: lease/heartbeat republishes and
+            # elastic-stream membership transitions rewrite the SAME
+            # key — the coordination service's default insert-only
+            # key_value_set rejects the second write (ALREADY_EXISTS).
+            self._client.key_value_set(
+                self._k(key), payload, allow_overwrite=True
+            )
+        except TypeError:
+            # Older clients lack the kwarg: emulate with delete+insert
+            # (non-atomic, but every overwriting caller here tolerates
+            # a reader seeing the brief gap as "absent").
+            self._client.key_value_delete(self._k(key))
+            self._client.key_value_set(self._k(key), payload)
 
     def try_get(self, key: str) -> Optional[bytes]:
         import base64

@@ -112,6 +112,14 @@ class FaultPlan:
       raises RankFailedError) while the parent test can still SIGCONT
       or SIGKILL the frozen process. The deterministic "host froze"
       fault the lease layer exists for.
+    - ``preempt``: ("write", 3, 30.0) → the 3rd write ATTEMPT delivers
+      SIGTERM to this process (index 0/``*`` = first attempt of the
+      kind), then SIGKILLs it ``grace_s`` seconds later if it is still
+      alive — the graceful-leave twin of ``wedge``: a cloud preemption
+      NOTICE with a hard deadline. A process whose SIGTERM handler
+      drains its work and leaves (e.g. ``DeltaStream.leave()``) within
+      the grace exits cleanly; one that ignores the notice dies like a
+      ``wedge``-then-kill. Fires at most once per plugin instance.
     """
 
     seed: int = 0
@@ -126,6 +134,7 @@ class FaultPlan:
     bandwidth_gbps: float = 0.0
     rank: Optional[int] = None
     wedge: Optional[Tuple[str, int]] = None
+    preempt: Optional[Tuple[str, int, float]] = None
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
@@ -165,6 +174,16 @@ class FaultPlan:
                 # ("write:*:5.0" or index 0 → every attempt).
                 kind, idx, secs = value.split(":")
                 plan.stall_op = (
+                    kind,
+                    0 if idx == "*" else int(idx),
+                    float(secs),
+                )
+            elif key == "preempt":
+                # "write:3:30" → 3rd write attempt gets SIGTERM with a
+                # 30 s SIGKILL deadline ("write:*:30" or index 0 → the
+                # first attempt).
+                kind, idx, secs = value.split(":")
+                plan.preempt = (
                     kind,
                     0 if idx == "*" else int(idx),
                     float(secs),
@@ -215,6 +234,8 @@ class _FaultState:
     kind_success: Dict[str, int] = field(default_factory=dict)
     kind_attempts: Dict[str, int] = field(default_factory=dict)
     wedge_attempts: Dict[str, int] = field(default_factory=dict)
+    preempt_attempts: Dict[str, int] = field(default_factory=dict)
+    preempt_fired: bool = False
     per_op_attempts: Dict[Tuple[str, str], int] = field(default_factory=dict)
     lock: threading.Lock = field(default_factory=threading.Lock)
     # Outage-window anchor (monotonic, set at this plugin's first op)
@@ -446,11 +467,62 @@ class FaultInjectionStoragePlugin(StoragePlugin):
         )
         os.kill(os.getpid(), signal.SIGSTOP)
 
+    def _check_preempt(self, kind: str) -> None:
+        """Deliver a preemption NOTICE on the planned attempt of
+        ``kind``: SIGTERM to this process now, SIGKILL ``grace_s``
+        seconds later if it is still alive (a daemon timer — a process
+        that exits within the grace implicitly cancels the kill). The
+        handler the app installed on SIGTERM gets a real, bounded
+        window to leave gracefully — the deterministic "spot instance
+        reclaim" fault elastic-leave tests run on."""
+        plan, st = self.plan, self._state
+        if plan.preempt is None or plan.preempt[0] != kind:
+            return
+        with st.lock:
+            if st.preempt_fired:
+                return
+            n = st.preempt_attempts.get(kind, 0) + 1
+            st.preempt_attempts[kind] = n
+            idx = plan.preempt[1]
+            if idx != 0 and n != idx:
+                return
+            st.preempt_fired = True
+        grace_s = plan.preempt[2]
+        telemetry.incr("faults.preempt")
+        flight.record("fault_preempt", op=kind, grace_s=grace_s)
+        # Flush the black box NOW: the SIGTERM handler may exit the
+        # process before the next heartbeat flush, and the preemption
+        # breadcrumb is what the post-mortem needs to tell a graceful
+        # leave from a silent death.
+        try:
+            flight.recorder().maybe_flush(force=True)
+        except Exception:
+            logger.debug("pre-preempt flight flush failed", exc_info=True)
+        logger.warning(
+            "FaultPlan preempt=%s: SIGTERM to pid %d (SIGKILL in %.1fs)",
+            plan.preempt,
+            os.getpid(),
+            grace_s,
+        )
+        pid = os.getpid()
+
+        def _hard_kill() -> None:
+            logger.warning(
+                "FaultPlan preempt grace expired: SIGKILLing pid %d", pid
+            )
+            os.kill(pid, signal.SIGKILL)
+
+        timer = threading.Timer(grace_s, _hard_kill)
+        timer.daemon = True
+        timer.start()
+        os.kill(pid, signal.SIGTERM)
+
     async def _pre(self, kind: str, path: str) -> bool:
         """Apply latency + injected stalls; return whether this attempt
         must fail."""
         self._check_outage(kind, path)
         self._check_wedge(kind)
+        self._check_preempt(kind)
         inject, latency = self._decide(kind, path)
         if latency:
             telemetry.incr("faults.latency_injections")

@@ -793,6 +793,18 @@ def postmortem_verdict(
                 }
             )
             or None,
+            # Ranks observed announcing a graceful departure (a
+            # ``rank_left`` event — their own, or a peer's observation):
+            # LEFT, not DEAD, in every rendering.
+            "left_ranks_seen": sorted(
+                {
+                    e.get("rank")
+                    for e in events
+                    if e.get("k") == "rank_left"
+                    and isinstance(e.get("rank"), int)
+                }
+            )
+            or None,
             "events": len(events),
             "dropped": meta.get("dropped", 0),
             "take_id": meta.get("take_id"),
@@ -805,8 +817,14 @@ def postmortem_verdict(
     # ranks the take DIED on, as opposed to ranks whose log merely
     # never flushed (missing_ranks covers those too).
     dead: set = set()
+    left: set = set()
     for r in ranks.values():
         dead.update(r.get("dead_ranks_seen") or ())
+        left.update(r.get("left_ranks_seen") or ())
+    # A rank that announced departure before its lease went stale LEFT;
+    # it must never be reported dead (the whole point of the `left`
+    # lease state).
+    dead -= left
     return {
         "path": path,
         "state": state,
@@ -814,6 +832,7 @@ def postmortem_verdict(
         "ranks": ranks,
         "missing_ranks": missing,
         "dead_ranks": sorted(dead),
+        "left_ranks": sorted(left),
         "stall_episodes": sum(
             r["stall_episodes"] for r in ranks.values()
         ),

@@ -51,6 +51,7 @@ _FLIGHT_RING_ENV_VAR = "TPUSNAP_FLIGHT_RING"
 _FLIGHT_FLUSH_ENV_VAR = "TPUSNAP_FLIGHT_FLUSH_S"
 _SLO_RPO_ENV_VAR = "TPUSNAP_SLO_RPO_S"
 _SLO_RTO_ENV_VAR = "TPUSNAP_SLO_RTO_S"
+_SLO_STREAM_CADENCE_X_ENV_VAR = "TPUSNAP_SLO_STREAM_CADENCE_X"
 _DELTA_CADENCE_ENV_VAR = "TPUSNAP_DELTA_CADENCE_S"
 _DELTA_MAX_CHAIN_ENV_VAR = "TPUSNAP_DELTA_MAX_CHAIN"
 _TIER_DRAIN_ENV_VAR = "TPUSNAP_TIER_DRAIN"
@@ -479,6 +480,22 @@ def get_slo_rto_threshold_s() -> float:
     return max(0.0, _get_float_env(_SLO_RTO_ENV_VAR, 0.0))
 
 
+def get_slo_stream_cadence_x() -> float:
+    """Stream-cadence gate multiplier of ``slo --check``
+    (:mod:`tpusnap.slo`): while a delta stream is LIVE (its SLO record
+    advertises a ``stream_cadence_s`` and is not a final record), the
+    observed time since the last commit must stay under this many
+    multiples of the declared cadence — beyond it the verdict is a
+    breach (exit 2): the stream has silently stalled and exposure is
+    growing past what the operator declared. ``0`` disables the gate;
+    values are floored at 1 (below 1x a healthy stream could never
+    pass). Default 3x."""
+    val = _get_float_env(_SLO_STREAM_CADENCE_X_ENV_VAR, 3.0)
+    if val <= 0:
+        return 0.0
+    return max(1.0, val)
+
+
 def get_delta_cadence_s() -> float:
     """Default micro-commit cadence of a delta stream
     (:meth:`tpusnap.Snapshot.stream` / :class:`tpusnap.delta.DeltaStream`)
@@ -902,6 +919,12 @@ def override_slo_thresholds(
             stack.enter_context(_override_env(_SLO_RPO_ENV_VAR, str(rpo_s)))
         if rto_s is not None:
             stack.enter_context(_override_env(_SLO_RTO_ENV_VAR, str(rto_s)))
+        yield
+
+
+@contextlib.contextmanager
+def override_slo_stream_cadence_x(factor: float) -> Generator[None, None, None]:
+    with _override_env(_SLO_STREAM_CADENCE_X_ENV_VAR, str(factor)):
         yield
 
 

@@ -787,6 +787,35 @@ def _fsck_impl(
             # sequence number and parent — recovery is "restore the
             # last committed increment", never this directory.
             report.delta = dict(report.journal.stream)
+            world = report.journal.stream.get("world")
+            ranks = (
+                world.get("ranks") if isinstance(world, dict) else None
+            )
+            if (
+                isinstance(ranks, list)
+                and ranks
+                and report.listing_supported
+            ):
+                # Multi-rank epoch: name the GLOBAL rank(s) whose
+                # per-rank evidence never landed — whose writes the
+                # tear interrupted (journal virtual rank v maps to
+                # global ranks[v]).
+                have = {
+                    v
+                    for v in range(len(ranks))
+                    if journal_rank_path(v) in files
+                }
+                missing = [
+                    int(ranks[v])
+                    for v in range(len(ranks))
+                    if v not in have
+                ]
+                if missing:
+                    report.detail = (
+                        "torn multi-rank micro-commit: journal evidence "
+                        f"missing from global rank(s) {missing} (world "
+                        f"{ranks})"
+                    )
         if report.journal is not None:
             # Already existence/size-filtered against the listing — what
             # a salvage-retake will actually consider (empty on backends

@@ -122,6 +122,15 @@ class LeasePublisher:
         state, so the mapping cannot drift."""
         self.publish(state="done" if state == "committed" else "aborted")
 
+    def leave(self) -> None:
+        """Terminal publish for a GRACEFUL DEPARTURE (preemption notice
+        honored, elastic scale-down): distinct from ``done``/``aborted``
+        — the rank neither committed nor failed, it announced it is
+        going away. Observers never expire it and never raise
+        :class:`RankFailedError` for it; renderers show LEFT, not
+        DEAD."""
+        self.publish(state="left")
+
     def make_tick_hook(self) -> Callable[[Optional[dict]], None]:
         """The heartbeat pump piggyback: republish the lease every tick
         (cheap — one KV set per rank per interval, same order as the
@@ -149,8 +158,10 @@ class LeasePublisher:
 
 #: Lease states that mean "this rank exited the take deliberately" —
 #: never expired by observers (the outcome travels via barrier keys or
-#: abort records, both faster than a TTL).
-_TERMINAL_STATES = ("done", "aborted")
+#: abort records, both faster than a TTL). ``left`` is the graceful
+#: elastic departure: not a commit, not a failure — the rank announced
+#: it is leaving the world, and watchers must never declare it dead.
+_TERMINAL_STATES = ("done", "aborted", "left")
 
 
 class LivenessMonitor:
@@ -188,6 +199,7 @@ class LivenessMonitor:
             r: (None, now) for r in range(world_size)
         }
         self._terminal: Set[int] = set()
+        self._left: Set[int] = set()
         self._last_refresh = -1e18
         self._throttle = max(0.1, ttl_s / 5.0)
         self._announced: Set[int] = set()
@@ -215,8 +227,11 @@ class LivenessMonitor:
                 continue
             if r not in self._last:
                 continue
-            if rec.get("state") in _TERMINAL_STATES:
+            state = rec.get("state")
+            if state in _TERMINAL_STATES:
                 self._terminal.add(r)
+                if state == "left":
+                    self._left.add(r)
             prev_seq, _prev_t = self._last[r]
             if seq != prev_seq:
                 self._last[r] = (seq, now)
@@ -313,4 +328,12 @@ class LivenessMonitor:
         waiting in a barrier). None when none observed."""
         with self._lock:
             out = sorted(self._announced)
+        return out or None
+
+    def left_ranks(self) -> Optional[List[int]]:
+        """Ranks that published a terminal ``left`` lease (graceful
+        departure). Observed as a side effect of the throttled
+        refreshes — no fresh KV read. None when none observed."""
+        with self._lock:
+            out = sorted(self._left)
         return out or None

@@ -198,6 +198,11 @@ class ProgressMonitor:
         self._liveness_probe: Optional[
             Callable[[], Optional[List[int]]]
         ] = None
+        # Departure probe: "which peer ranks announced a graceful
+        # LEAVE?" — rendered LEFT (elastic scale-down), never DEAD.
+        self._left_probe: Optional[
+            Callable[[], Optional[List[int]]]
+        ] = None
         self._clock = clock
         self._wall = wall_clock
         self._state = "running"
@@ -255,11 +260,26 @@ class ProgressMonitor:
         Best-effort like every observability hook."""
         self._liveness_probe = fn
 
+    def set_left_probe(
+        self, fn: Callable[[], Optional[List[int]]]
+    ) -> None:
+        """Register the graceful-departure probe (see ``_left_probe``).
+        Best-effort like every observability hook."""
+        self._left_probe = fn
+
     def _probe_dead_ranks(self) -> Optional[List[int]]:
         if self._liveness_probe is None:
             return None
         try:
             return self._liveness_probe()
+        except Exception:
+            return None
+
+    def _probe_left_ranks(self) -> Optional[List[int]]:
+        if self._left_probe is None:
+            return None
+        try:
+            return self._left_probe()
         except Exception:
             return None
 
@@ -477,6 +497,12 @@ class ProgressMonitor:
         # `watch` flags them so an operator sees "rank 2 died" on the
         # survivors' rows, not just a stalled percentage.
         dead = self._probe_dead_ranks()
+        left = self._probe_left_ranks()
+        if left:
+            rec["left_ranks"] = left
+            # A rank that announced departure is not dead — never let
+            # the two flags contradict on one record.
+            dead = [r for r in (dead or []) if r not in left] or None
         if dead:
             rec["dead_ranks"] = dead
         if self._slo_provider is not None:
@@ -646,6 +672,9 @@ def render_watch_table(
         dead = r.get("dead_ranks")
         if dead:
             flag = f"  ** PEER DEAD {dead}" + flag
+        left = r.get("left_ranks")
+        if left:
+            flag = f"  ** PEER LEFT {left}" + flag
         # With in-take probes on, express live MB/s against the latest
         # self-measured ceiling — "600 MB/s (31% of ceiling)" answers
         # "is that slow?" without leaving the table.

@@ -73,6 +73,7 @@ from .knobs import (
     get_heartbeat_interval_s,
     get_slo_rpo_threshold_s,
     get_slo_rto_threshold_s,
+    get_slo_stream_cadence_x,
     get_telemetry_dir,
 )
 
@@ -939,6 +940,7 @@ def evaluate_records(
         rpo_threshold_s = get_slo_rpo_threshold_s() or None
     if rto_threshold_s is None:
         rto_threshold_s = get_slo_rto_threshold_s() or None
+    stream_x = get_slo_stream_cadence_x()
     rows: List[Dict[str, Any]] = []
     any_rto = False
     breach = False
@@ -982,9 +984,27 @@ def evaluate_records(
             and isinstance(rto, (int, float))
             and rto > rto_threshold_s
         )
+        # The stream-cadence gate: a LIVE delta stream (non-final record
+        # advertising a cadence) that has not committed for more than
+        # N x its declared cadence has silently stalled — that is a
+        # breach even with no absolute RPO threshold configured (the
+        # declared cadence IS the operator's objective).
+        cadence = row["stream_cadence_s"]
+        row["breach_stream"] = bool(
+            stream_x
+            and not final
+            and isinstance(cadence, (int, float))
+            and cadence > 0
+            and since_commit > stream_x * cadence
+        )
         if isinstance(rto, (int, float)):
             any_rto = True
-        breach = breach or row["breach_rpo"] or row["breach_rto"]
+        breach = (
+            breach
+            or row["breach_rpo"]
+            or row["breach_rto"]
+            or row["breach_stream"]
+        )
         rows.append(row)
     if not rows:
         verdict = "insufficient"
@@ -996,6 +1016,11 @@ def evaluate_records(
             f"rank {worst['rank']}: {worst['since_commit_s']:.1f}s since "
             f"last commit, {worst['data_at_risk_bytes']} bytes at risk"
         )
+        if worst.get("breach_stream"):
+            reason += (
+                f" (live stream declared a {worst['stream_cadence_s']}s "
+                f"cadence; observed RPO exceeds {stream_x:g}x it)"
+            )
     elif rto_threshold_s and not any_rto:
         verdict = "insufficient"
         reason = (
@@ -1008,6 +1033,10 @@ def evaluate_records(
     return {
         "verdict": verdict,
         "reason": reason,
-        "thresholds": {"rpo_s": rpo_threshold_s, "rto_s": rto_threshold_s},
+        "thresholds": {
+            "rpo_s": rpo_threshold_s,
+            "rto_s": rto_threshold_s,
+            "stream_cadence_x": stream_x or None,
+        },
         "ranks": rows,
     }

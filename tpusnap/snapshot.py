@@ -476,6 +476,7 @@ class Snapshot:
         _extras: Optional[Dict[str, Any]] = None,
         _record_dedup_hashes: bool = False,
         _force_clone_staging: bool = False,
+        _stream_capture: bool = False,
     ) -> "PendingSnapshot":
         comm = get_communicator(comm)
         event_loop = asyncio.new_event_loop()
@@ -505,6 +506,7 @@ class Snapshot:
                 extras=_extras,
                 force_dedup_hashes=_record_dedup_hashes,
                 force_clone_staging=_force_clone_staging,
+                stream_capture=_stream_capture,
             )
             # Control returns to training here: the blocked window is
             # over — the first staging window is staged (ALL staging,
@@ -1060,6 +1062,7 @@ def _take_impl(
     extras: Optional[Dict[str, Any]] = None,
     force_dedup_hashes: bool = False,
     force_clone_staging: bool = False,
+    stream_capture: bool = False,
 ):
     """Core take flow. Exactly TWO all-gathers in the default
     multi-process path (the reference issues ~6 collectives,
@@ -1382,6 +1385,9 @@ def _take_impl(
                 progress_monitor.set_liveness_probe(
                     liveness_monitor.dead_ranks
                 )
+                progress_monitor.set_left_probe(
+                    liveness_monitor.left_ranks
+                )
                 abort_ctx.arm_liveness(lease, liveness_monitor)
             except Exception:
                 logger.warning(
@@ -1642,8 +1648,10 @@ def _take_impl(
         multi
         and abort_ctx is not None
         and abort_ctx.liveness is not None
-        and incremental_from is None
-        and not is_async_snapshot
+        and (
+            (incremental_from is None and not is_async_snapshot)
+            or stream_capture
+        )
         and get_rank_failure_policy() == "degrade"
     ):
         # Everything a degraded commit needs is final here — armed
@@ -1661,6 +1669,19 @@ def _take_impl(
         # seconds, torn and salvageable). Incremental takes never
         # degrade either (their dedup decisions reference per-rank base
         # views the dead rank's evidence is part of).
+        #
+        # STREAM CAPTURES (`stream_capture=True`, delta-stream epoch
+        # micro-commits) are the deliberate exception to both
+        # exclusions, because the stream pins both hazards shut:
+        # `_force_clone_staging` freezes every byte into take-owned
+        # clones before control returns (adoption re-stages CLONED
+        # capture-time bytes, never post-return caller state), and the
+        # stream's epoch protocol hands every member the same parent
+        # member as the dedup base, with replicated state SPMD-
+        # identical across members — so a survivor's dedup view of a
+        # replicated entry is byte-for-byte the dead rank's. A sharded
+        # leaf still refuses inside the degraded commit itself
+        # (_degrade_eligible), aborting to a torn, salvageable epoch.
         abort_ctx.degrade = _DegradeContext(
             comm=comm,
             take_id=take_id,
@@ -2946,16 +2967,61 @@ class PendingSnapshot(_BackgroundWork):
         self._start()
 
     def _body(self) -> None:
-        # A RankFailedError from the barrier waits here takes the
-        # normal abort path (_on_error): async takes never run the
-        # degraded commit — the caller may mutate host-aliasing state
-        # the moment async_take returns, so adoption's re-staging could
-        # capture post-return bytes (the degrade context is only armed
-        # for sync takes). Detection is still seconds, and the torn
-        # state salvages on retake.
+        # A RankFailedError from the barrier waits here ordinarily
+        # takes the normal abort path (_on_error): a plain async take
+        # never runs the degraded commit — the caller may mutate
+        # host-aliasing state the moment async_take returns, so
+        # adoption's re-staging could capture post-return bytes (the
+        # degrade context is not armed for it). Stream captures
+        # (`_stream_capture=True`) DO arm it — their force-cloned
+        # staging froze take-owned copies of every byte, so adoption
+        # re-stages capture-time state regardless of what the caller
+        # does after return — and the handler below completes the
+        # micro-commit on the survivors. A failed or refused degrade
+        # re-raises into the normal abort path (torn, salvageable).
         tele = self._tele_commit.tele if self._tele_commit is not None else None
         with telemetry.use(tele):
-            self._body_impl()
+            try:
+                self._body_impl()
+            except RankFailedError as rank_exc:
+                degraded_meta = _maybe_degraded_commit(
+                    self._abort_ctx, rank_exc
+                )
+                if degraded_meta is None:
+                    raise
+                self._commit_degraded(degraded_meta)
+
+    def _commit_degraded(self, metadata: SnapshotMetadata) -> None:
+        # Mirror of the sync take's degraded tail: the survivor-set
+        # protocol already wrote the metadata and cleared the journal;
+        # this rank only records the commit and builds the handle.
+        # Storage/event-loop teardown stays in _cleanup, as on the
+        # normal path.
+        self._metadata = metadata
+        ctx = self._abort_ctx
+        assert ctx is not None and ctx.degrade is not None
+        try:
+            self._comm.gc_consumed_keys(self._gc_epoch)
+        except Exception:
+            pass
+        if self._tele_commit is not None:
+            if self._tele_commit.tele is not None:
+                self._tele_commit.tele.meta["completed"] = True
+            _record_slo_commit(
+                self._tele_commit.tele,
+                metadata,
+                ctx.degrade.take_id,
+                self.path,
+                self._comm.rank,
+            )
+            self._tele_commit.finish_progress()
+        from . import flight as _flight_mod
+
+        _flight_mod.recorder().end_take("committed")
+        snapshot = Snapshot(self.path, self._storage_options, self._comm)
+        # Every survivor built the identical degraded manifest.
+        snapshot._metadata = metadata
+        self._snapshot = snapshot
 
     def _body_impl(self) -> None:
         tele = self._tele_commit.tele if self._tele_commit is not None else None
