@@ -1,0 +1,364 @@
+"""Fleet soak: N concurrent snapshot lifecycles, one shared tier,
+seeded chaos, graded by the fleet observability layer itself.
+
+No reference counterpart (torchsnapshot has no cross-job story at all).
+Spawns a small fleet — trainers in a take loop, one continuous delta
+stream, one restore loop — every job a real OS process with its own
+``TPUSNAP_JOB_ID``, all publishing into one shared ``TPUSNAP_FLEET_DIR``
+and all writing through one shared local+remote write-back tier. Seeded
+faults (``TPUSNAP_FAULT_SPEC`` on ``chaos+fs://`` remotes) hit selected
+jobs:
+
+- a sustained REMOTE OUTAGE window on one trainer's drain,
+- a RANK KILL (SIGKILL mid-write) on another — its fleet record must
+  stay non-final and keep growing exposure in the fold,
+- a WEDGE (SIGSTOP inside a write; the parent SIGCONTs it back) on a
+  third,
+- a BANDWIDTH CAP starving a fourth's drain, and
+- per-op transient faults on the delta stream.
+
+The sim then grades itself with its own tooling: ``python -m tpusnap
+fleet --check`` over the shared fleet dir must be HEALTHY (generous
+thresholds — the seeded faults are survivable by design; only the
+SIGKILLed job may miss its commit), a per-job committed verdict is
+printed from the children's own reports, a ``kind="fleet"`` history
+event (worst RPO, aggregate upload lag, merged storage p99, wall) is
+recorded for trend gating, and ``history --check --kind fleet`` runs
+against it (exit 3 = first run, no baseline yet — accepted).
+
+Run: python benchmarks/fleet/fleetsim.py [--jobs 8] [--takes 3]
+     [--mb 4] [--seed 0] [--timeout 300] [--keep]
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+RESULT_TAG = "FLEETSIM_RESULT "
+
+
+# --------------------------------------------------------------- children
+
+
+def _mk_state(mb: float, seed: int):
+    import numpy as np
+
+    from tpusnap import StateDict
+
+    rng = np.random.default_rng(seed)
+    n = max(int(mb * 1e6) // 4, 1024)
+    return {
+        "app": StateDict(
+            weights=rng.standard_normal(n).astype(np.float32),
+            step=np.int64(0),
+        )
+    }
+
+
+def run_trainer(args) -> dict:
+    """A training job: ``--takes`` takes through the shared write-back
+    tier (local cache + chaos-wrapped shared remote)."""
+    import numpy as np
+
+    from tpusnap import Snapshot
+
+    state = _mk_state(args.mb, args.seed + args.index)
+    committed = 0
+    for k in range(args.takes):
+        state["app"]["weights"] += np.float32(1.0)
+        state["app"]["step"] = np.int64(k)
+        url = (
+            f"tier+local={args.base}/local/{args.job}/t{k}"
+            f"+remote=chaos+fs://{args.base}/remote/{args.job}/t{k}"
+        )
+        Snapshot.take(url, state)
+        committed += 1
+        time.sleep(args.pause)
+    return {"committed": committed, "takes": args.takes}
+
+
+def run_stream(args) -> dict:
+    """A continuous-checkpointing job: one delta stream, a handful of
+    explicit micro-commits under per-op transient faults."""
+    import numpy as np
+
+    from tpusnap import Snapshot
+
+    state = _mk_state(args.mb, args.seed + args.index)
+    root = f"chaos+fs://{args.base}/remote/{args.job}/stream"
+    stream = Snapshot.stream(root, state, cadence_s=30.0)
+    commits = 0
+    try:
+        for k in range(args.takes):
+            state["app"]["weights"] += np.float32(0.5)
+            state["app"]["step"] = np.int64(k)
+            stream.commit_now()
+            commits += 1
+            time.sleep(args.pause)
+    finally:
+        stream.close(final_commit=False)
+    return {"committed": commits, "takes": args.takes}
+
+
+def run_restorer(args) -> dict:
+    """A restore-loop job: seed take, then repeated restores from it
+    (the read side of the shared substrate), then one final take so the
+    job's last fleet record is a committed one."""
+    import numpy as np
+
+    from tpusnap import Snapshot
+
+    state = _mk_state(args.mb, args.seed + args.index)
+    seed_path = f"chaos+fs://{args.base}/remote/{args.job}/seed"
+    Snapshot.take(seed_path, state)
+    restores = 0
+    for _ in range(args.takes):
+        Snapshot(seed_path).restore(state)
+        restores += 1
+        time.sleep(args.pause)
+    state["app"]["weights"] += np.float32(1.0)
+    Snapshot.take(f"chaos+fs://{args.base}/remote/{args.job}/final", state)
+    return {"committed": 1 + restores, "takes": args.takes}
+
+
+def child_main(args) -> int:
+    t0 = time.time()
+    fn = {"trainer": run_trainer, "stream": run_stream,
+          "restore": run_restorer}[args.role]
+    out = {"job": args.job, "role": args.role, "ok": False}
+    try:
+        out.update(fn(args))
+        out["ok"] = True
+    except Exception as e:  # report, don't traceback-spam the parent
+        out["error"] = f"{type(e).__name__}: {e}"
+    out["wall_s"] = round(time.time() - t0, 2)
+    print(RESULT_TAG + json.dumps(out), flush=True)
+    return 0 if out["ok"] else 1
+
+
+# ----------------------------------------------------------------- parent
+
+# (role, fault spec for the child's chaos+fs remote). Survivable by
+# design except the SIGKILL — that job's missing commit is EXPECTED.
+FAULTS = {
+    0: "seed=1,outage=write:0:3",  # remote down 3s, drain must ride it out
+    1: None,  # placeholder — killed job, spec built from --kill-after
+    2: "seed=3,bandwidth_gbps=0.05",  # starved drain pipe
+    3: "seed=4,wedge=write:*",  # SIGSTOP mid-write; parent SIGCONTs
+}
+STREAM_FAULT = "seed=5,transient_per_op=1"
+
+
+def spawn_job(args, index: int, role: str, base: str, fleet_dir: str):
+    job = f"fleetsim-{role}{index}"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        TPUSNAP_JOB_ID=job,
+        TPUSNAP_FLEET_DIR=fleet_dir,
+        TPUSNAP_TELEMETRY_DIR=os.path.join(base, "telemetry", job),
+        TPUSNAP_HEARTBEAT_INTERVAL_S="0.1",
+    )
+    if role == "trainer" and index in FAULTS:
+        spec = FAULTS[index]
+        if index == 1:
+            spec = f"seed=2,crash_after_op=write:{args.kill_after}"
+        if spec:
+            env["TPUSNAP_FAULT_SPEC"] = spec
+    elif role == "stream":
+        env["TPUSNAP_FAULT_SPEC"] = STREAM_FAULT
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--child", "--role", role, "--index", str(index),
+        "--job", job, "--base", base,
+        "--takes", str(args.takes), "--mb", str(args.mb),
+        "--seed", str(args.seed), "--pause", str(args.pause),
+        "--kill-after", str(args.kill_after),
+    ]
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    return {"job": job, "role": role, "index": index, "proc": proc,
+            "wedged": role == "trainer" and index == 3}
+
+
+def cli(cmd, env=None):
+    r = subprocess.run(
+        [sys.executable, "-m", "tpusnap"] + cmd,
+        capture_output=True, text=True, env=env,
+    )
+    return r.returncode, r.stdout, r.stderr
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--jobs", type=int, default=8,
+                        help="fleet size (>= 4; default 8)")
+    parser.add_argument("--takes", type=int, default=3)
+    parser.add_argument("--mb", type=float, default=4.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--pause", type=float, default=0.2,
+                        help="per-step sleep inside each job")
+    parser.add_argument("--kill-after", type=int, default=1, dest="kill_after",
+                        help="SIGKILL the doomed trainer after its Nth "
+                        "remote payload write (per-take plugin "
+                        "instances reset the counter — 1 fires in the "
+                        "first drain)")
+    parser.add_argument("--timeout", type=float, default=300.0)
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the working directory")
+    parser.add_argument("--json", action="store_true")
+    # child-mode plumbing
+    parser.add_argument("--child", action="store_true")
+    parser.add_argument("--role", default=None)
+    parser.add_argument("--index", type=int, default=0)
+    parser.add_argument("--job", default=None)
+    parser.add_argument("--base", default=None)
+    args = parser.parse_args()
+
+    if args.child:
+        return child_main(args)
+
+    if args.jobs < 4:
+        parser.error("--jobs must be >= 4 (trainers + stream + restore)")
+    base = args.base or tempfile.mkdtemp(prefix="tpusnap_fleetsim_")
+    fleet_dir = os.path.join(base, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    n_trainers = args.jobs - 2
+    t0 = time.time()
+    jobs = [
+        spawn_job(args, i, "trainer", base, fleet_dir)
+        for i in range(n_trainers)
+    ]
+    jobs.append(spawn_job(args, n_trainers, "stream", base, fleet_dir))
+    jobs.append(spawn_job(args, n_trainers + 1, "restore", base, fleet_dir))
+    print(f"fleet: {len(jobs)} job(s) under {base} "
+          f"(faults on trainers 0-3 + the stream; trainer 1 is doomed)")
+
+    # Babysit: SIGCONT the wedged job each poll (a running process
+    # ignores SIGCONT, a SIGSTOPped one resumes — bounding the freeze
+    # to ~one poll interval), hard-kill anything past the deadline.
+    deadline = time.time() + args.timeout
+    results = {}
+    while any(j["proc"].poll() is None for j in jobs):
+        for j in jobs:
+            if j["wedged"] and j["proc"].poll() is None:
+                try:
+                    os.kill(j["proc"].pid, signal.SIGCONT)
+                except OSError:
+                    pass
+        if time.time() > deadline:
+            for j in jobs:
+                if j["proc"].poll() is None:
+                    j["proc"].kill()
+            break
+        time.sleep(1.0)
+    for j in jobs:
+        stdout, stderr = j["proc"].communicate()
+        rc = j["proc"].returncode
+        rep = None
+        for line in (stdout or "").splitlines():
+            if line.startswith(RESULT_TAG):
+                rep = json.loads(line[len(RESULT_TAG):])
+        results[j["job"]] = {
+            "role": j["role"], "rc": rc,
+            "report": rep,
+            "killed": rc is not None and rc < 0,
+        }
+
+    # Per-job committed verdict from the children's own reports.
+    doomed = "fleetsim-trainer1"
+    print(f"\n{'job':<22} {'role':<8} {'rc':>4} {'committed':>9}  verdict")
+    failures = []
+    for name, r in sorted(results.items()):
+        rep = r["report"] or {}
+        committed = rep.get("committed", 0)
+        expected_kill = name == doomed
+        ok = (rep.get("ok") and r["rc"] == 0) or (expected_kill and r["killed"])
+        if expected_kill and r["killed"]:
+            verdict = "KILLED (expected)"
+        elif ok:
+            verdict = "ok"
+        else:
+            verdict = "FAIL ({})".format(
+                rep.get("error") or "rc={}".format(r["rc"])
+            )
+        if not ok:
+            failures.append(name)
+        print(f"{name:<22} {r['role']:<8} {str(r['rc']):>4} "
+              f"{committed:>9}  {verdict}")
+
+    # Grade 1: the fleet gate over what every job published. Thresholds
+    # are generous — the seeded faults are survivable; the gate exists
+    # to catch jobs that silently never published or never committed.
+    rc, out, err = cli(["fleet", "--dir", fleet_dir, "--json", "--check",
+                        "--rpo", "3600", "--lag-s", "3600"])
+    fleet_doc = json.loads(out) if rc in (0, 2, 3) and out else {}
+    rollup = fleet_doc.get("rollup") or {}
+    print(f"\nfleet --check: rc={rc} "
+          f"({(fleet_doc.get('verdict') or '?').upper()}: "
+          f"{fleet_doc.get('reason')})")
+    if rc != 0:
+        failures.append(f"fleet-check-rc{rc}")
+    if rollup.get("n_jobs", 0) < len(jobs):
+        failures.append(
+            f"fleet-records-{rollup.get('n_jobs', 0)}-of-{len(jobs)}"
+        )
+
+    # Grade 2: record the fleet soak as a kind="fleet" history event and
+    # run the trend gate over it (exit 3 = first run, no baseline).
+    wall = round(time.time() - t0, 2)
+    w = (rollup.get("storage") or {}).get("write") or {}
+    from tpusnap.history import record_event
+
+    record_event({
+        "kind": "fleet",
+        "ts": time.time(),
+        "jobs": len(jobs),
+        "committed_jobs": sum(
+            1 for r in results.values() if (r["report"] or {}).get("ok")
+        ),
+        "worst_rpo_s": rollup.get("worst_rpo_s"),
+        "lag_bytes_total": rollup.get("lag_bytes_total"),
+        "storage_write_p99_s": w.get("p99_s"),
+        "wall_s": wall,
+    })
+    rc_h, out_h, _ = cli(["history", "--check", "--kind", "fleet",
+                          "--metric", "wall_s"])
+    print(f"history --check --kind fleet: rc={rc_h} "
+          f"({'no baseline yet' if rc_h == 3 else out_h.strip()})")
+    if rc_h not in (0, 3):
+        failures.append(f"history-check-rc{rc_h}")
+
+    if args.json:
+        print(json.dumps({
+            "jobs": {k: {kk: vv for kk, vv in v.items() if kk != "proc"}
+                     for k, v in results.items()},
+            "rollup": rollup,
+            "fleet_check_rc": rc,
+            "wall_s": wall,
+            "failures": failures,
+        }))
+    if not args.keep and not failures:
+        import shutil
+
+        shutil.rmtree(base, ignore_errors=True)
+    elif failures:
+        print(f"(kept {base} for inspection)")
+    print(f"\nfleetsim: {len(jobs)} job(s) in {wall:.1f}s — "
+          + ("PASS" if not failures else f"FAIL: {failures}"))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

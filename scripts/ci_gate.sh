@@ -58,7 +58,13 @@
 #      (fsck-clean chain, bit-exact restore), and a graceful
 #      `leave()` + later re-join must re-plan the epoch world with
 #      the joins/leaves recorded in the per-epoch chain metadata
-#  12. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
+#  12. mini-fleetsim smoke — 3 concurrent jobs (one SIGKILLed by a
+#      rank-kill fault, one writing through a seeded outage window)
+#      publishing into one shared TPUSNAP_FLEET_DIR; `tpusnap fleet
+#      --check` must honor its full exit contract: 3 on the empty
+#      fleet dir, 0 across the live fleet under generous thresholds,
+#      2 against a seeded stale (non-final, old-commit) job record
+#  13. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
 #      and/or `minio` binary is on PATH, run the `cloud_real` pytest
 #      marker against the real server processes (skipped silently
 #      when the binaries are absent)
@@ -80,14 +86,14 @@ cd "$(dirname "$0")/.."
 fail() { echo "ci_gate: FAIL — $1" >&2; exit "$2"; }
 
 # ---- 1. static analysis --------------------------------------------------
-echo "ci_gate: [1/12] lint --check (AST invariants)"
+echo "ci_gate: [1/13] lint --check (AST invariants)"
 env JAX_PLATFORMS=cpu python -m tpusnap lint --check
 rc=$?
 [ "$rc" -eq 0 ] || fail "tpusnap lint --check (rc=$rc)" "$rc"
 
 # ---- 2. tier-1 -----------------------------------------------------------
 if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
-    echo "ci_gate: [2/12] tier-1 tests"
+    echo "ci_gate: [2/13] tier-1 tests"
     rm -f /tmp/_t1.log
     # cloud_real excluded here: on a host with the server binaries the
     # real-backend suite belongs to step 8, not inside the fast tier.
@@ -98,11 +104,11 @@ if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
     echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
     [ "$rc" -eq 0 ] || fail "tier-1 tests (rc=$rc)" "$rc"
 else
-    echo "ci_gate: [2/12] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
+    echo "ci_gate: [2/13] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
 fi
 
 # ---- 3. cross-run history gate ------------------------------------------
-echo "ci_gate: [3/12] history --check (throughput + p99 write latency)"
+echo "ci_gate: [3/13] history --check (throughput + p99 write latency)"
 for kind in take bench; do
     python -m tpusnap history --check --kind "$kind" \
         --metric throughput_gbps --metric storage_write_p99_s --json
@@ -117,7 +123,7 @@ done
 # ---- 4. analyze doctor on the latest snapshot ---------------------------
 SNAP="${1:-${TPUSNAP_CI_SNAPSHOT:-}}"
 if [ -n "$SNAP" ]; then
-    echo "ci_gate: [4/12] analyze --check $SNAP"
+    echo "ci_gate: [4/13] analyze --check $SNAP"
     python -m tpusnap analyze --check --history "$SNAP"
     rc=$?
     case "$rc" in
@@ -126,11 +132,11 @@ if [ -n "$SNAP" ]; then
         *) fail "analyze --check $SNAP (rc=$rc)" "$rc" ;;
     esac
 else
-    echo "ci_gate: [4/12] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
+    echo "ci_gate: [4/13] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
 fi
 
 # ---- 5. checkpoint-SLO gate smoke ---------------------------------------
-echo "ci_gate: [5/12] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
+echo "ci_gate: [5/13] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, subprocess, sys, tempfile, time
 
@@ -187,7 +193,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "slo --check smoke (rc=$rc)" "$rc"
 
 # ---- 6. delta soak smoke -------------------------------------------------
-echo "ci_gate: [6/12] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
+echo "ci_gate: [6/13] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, re, shutil, signal, subprocess, sys, tempfile, time
 
@@ -331,7 +337,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "delta soak smoke (rc=$rc)" "$rc"
 
 # ---- 7. flight-recorder timeline smoke ----------------------------------
-echo "ci_gate: [7/12] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
+echo "ci_gate: [7/13] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, signal, subprocess, sys, tempfile
 
@@ -405,7 +411,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "timeline smoke (rc=$rc)" "$rc"
 
 # ---- 8. write-back tiering smoke ----------------------------------------
-echo "ci_gate: [8/12] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
+echo "ci_gate: [8/13] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, signal, subprocess, sys, tempfile
 
@@ -495,7 +501,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "tiering smoke (rc=$rc)" "$rc"
 
 # ---- 9. fused-compression smoke ------------------------------------------
-echo "ci_gate: [9/12] compression smoke (compressed take -> fsck/scrub clean -> bit-exact restore; auto bypasses locally, compresses on a throttled pipe)"
+echo "ci_gate: [9/13] compression smoke (compressed take -> fsck/scrub clean -> bit-exact restore; auto bypasses locally, compresses on a throttled pipe)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, sys, tempfile
 
@@ -606,7 +612,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "compression smoke (rc=$rc)" "$rc"
 
 # ---- 10. rank-failure smoke ----------------------------------------------
-echo "ci_gate: [10/12] rank-failure smoke (chaos rank-kill -> fast RankFailedError; degrade-mode replicated take -> committed + scrub clean)"
+echo "ci_gate: [10/13] rank-failure smoke (chaos rank-kill -> fast RankFailedError; degrade-mode replicated take -> committed + scrub clean)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import atexit, os, re, shutil, subprocess, sys, tempfile
 
@@ -752,7 +758,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "rank-failure smoke (rc=$rc)" "$rc"
 
 # ---- 11. elastic-stream smoke ---------------------------------------------
-echo "ci_gate: [11/12] elastic-stream smoke (2-process stream survives a SIGKILLed rank via a degraded epoch; graceful leave + re-join re-plan the world)"
+echo "ci_gate: [11/13] elastic-stream smoke (2-process stream survives a SIGKILLed rank via a degraded epoch; graceful leave + re-join re-plan the world)"
 env JAX_PLATFORMS=cpu TPUSNAP_HISTORY=0 python -m pytest -q \
     tests/test_stream_elastic.py::test_stream_survives_rank_sigkill \
     tests/test_stream_elastic.py::test_stream_graceful_leave_and_rejoin \
@@ -760,9 +766,114 @@ env JAX_PLATFORMS=cpu TPUSNAP_HISTORY=0 python -m pytest -q \
 rc=$?
 [ "$rc" -eq 0 ] || fail "elastic-stream smoke (rc=$rc)" "$rc"
 
-# ---- 12. optional real-backend cloud suite -------------------------------
+# ---- 12. fleet observability smoke ----------------------------------------
+echo "ci_gate: [12/13] mini-fleetsim smoke (3 jobs, rank-kill + outage faults; fleet --check exit contract: 0 healthy / 2 breach / 3 no records)"
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import atexit, json, os, shutil, signal, subprocess, sys, tempfile, time
+
+work = tempfile.mkdtemp(prefix="tpusnap_ci_fleet_")
+atexit.register(shutil.rmtree, work, True)
+fleet_dir = os.path.join(work, "fleet")
+
+def die(msg):
+    print(f"mini-fleetsim: FAIL - {msg}", file=sys.stderr)
+    sys.exit(1)
+
+def fleet(*extra, check=True):
+    return subprocess.run(
+        [sys.executable, "-m", "tpusnap", "fleet", "--dir", fleet_dir,
+         *(["--check"] if check else []), *extra],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120,
+    )
+
+# (a) empty fleet dir -> exit 3 (no verdict without records).
+os.makedirs(fleet_dir)
+r = fleet()
+if r.returncode != 3:
+    die(f"empty dir: expected exit 3, got {r.returncode}: {r.stdout[-300:]}")
+
+# (b) 3 concurrent jobs against one shared fleet dir: a healthy
+# trainer, one writing through a seeded 2 s outage window, and one
+# SIGKILLed by a chaos rank-kill after its first blob write. Hermetic:
+# per-job telemetry dirs under the workdir, HOST history untouched.
+_JOB = (
+    "import os, sys; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+    "import jax; jax.config.update('jax_platforms','cpu')\n"
+    "import numpy as np\n"
+    "from tpusnap import Snapshot, StateDict\n"
+    "state={'m': StateDict(w=np.arange(1<<18, dtype=np.float32))}\n"
+    "for k in range(2):\n"
+    "    Snapshot.take(f'chaos+fs://{sys.argv[1]}/t{k}', state)\n"
+)
+jobs = []
+for name, fault in (
+    ("mini-ok", None),
+    ("mini-outage", "seed=1,transient_per_op=0,outage=write:0:2"),
+    # latency_ms keeps the doomed job alive across a few 50 ms
+    # heartbeat ticks so its fleet record exists before the SIGKILL.
+    ("mini-killed", "seed=2,transient_per_op=0,latency_ms=300,"
+                    "crash_after_op=write:2"),
+):
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        TPUSNAP_FLEET_DIR=fleet_dir, TPUSNAP_JOB_ID=name,
+        TPUSNAP_TELEMETRY_DIR=os.path.join(work, "tele", name),
+        TPUSNAP_HISTORY="0", TPUSNAP_HEARTBEAT_INTERVAL_S="0.05",
+        TPUSNAP_DISABLE_BATCHING="1",
+    )
+    if fault:
+        env["TPUSNAP_FAULT_SPEC"] = fault
+    jobs.append((name, subprocess.Popen(
+        [sys.executable, "-c", _JOB, os.path.join(work, "dest", name)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )))
+rcs = {}
+for name, p in jobs:
+    out, _ = p.communicate(timeout=180)
+    rcs[name] = p.returncode
+    if name == "mini-killed":
+        if p.returncode != -signal.SIGKILL:
+            die(f"{name}: expected SIGKILL, got {p.returncode}: {out[-400:]}")
+    elif p.returncode != 0:
+        die(f"{name}: rc={p.returncode}: {out[-400:]}")
+
+# All three jobs left a record (the killed one non-final) -> healthy
+# under generous thresholds -> exit 0.
+r = fleet("--rpo", "3600", "--lag-s", "3600", "--json")
+if r.returncode != 0:
+    die(f"healthy leg: expected exit 0, got {r.returncode}: {r.stdout[-400:]}")
+doc = json.loads(r.stdout)
+if doc["rollup"]["n_jobs"] < 3:
+    die(f"expected >=3 job records, folded {doc['rollup']['n_jobs']}")
+killed = [j for j in doc["rollup"]["jobs"] if j["job_id"] == "mini-killed"]
+if not killed or killed[0]["final"]:
+    die(f"SIGKILLed job must leave a NON-final record: {killed}")
+
+# (c) seeded stale job (non-final record, 15-minute-old commit) + a
+# tight --rpo -> breach -> exit 2.
+now = time.time()
+stale = {
+    "v": 1, "job_id": "mini-stale", "pid": 1, "ts": now - 850,
+    "rank": 0, "world_size": 1, "state": "running",
+    "slo": {"last_commit_ts": now - 900, "started_ts": now - 900,
+            "data_at_risk_bytes": 1 << 20},
+}
+with open(os.path.join(fleet_dir, "mini-stale.json"), "w") as f:
+    json.dump(stale, f)
+r = fleet("--rpo", "60")
+if r.returncode != 2:
+    die(f"stale breach: expected exit 2, got {r.returncode}: {r.stdout[-400:]}")
+if "mini-stale" not in r.stdout:
+    die(f"breach verdict does not name the stale job: {r.stdout[-400:]}")
+print("mini-fleetsim: OK (3/3 contract legs across a 3-job fleet)")
+PYEOF
+rc=$?
+[ "$rc" -eq 0 ] || fail "mini-fleetsim smoke (rc=$rc)" "$rc"
+
+# ---- 13. optional real-backend cloud suite -------------------------------
 if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&1; then
-    echo "ci_gate: [12/12] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
+    echo "ci_gate: [13/13] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m cloud_real \
         -p no:cacheprovider -p no:xdist -p no:randomly
     rc=$?
@@ -772,7 +883,7 @@ if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&
         fail "real-backend cloud suite (rc=$rc)" "$rc"
     fi
 else
-    echo "ci_gate: [12/12] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
+    echo "ci_gate: [13/13] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
 fi
 
 echo "ci_gate: PASS"

@@ -762,8 +762,11 @@ def test_decision_and_ratio_ride_summary_history_and_prom(tmp_path):
 
     sink = PrometheusTextfileSink(directory=str(tmp_path / "prom"))
     sink.on_take_summary(summary)
+    from tpusnap.knobs import get_job_id
+
     prom_file = os.path.join(
-        str(tmp_path / "prom"), f"tpusnap_rank{summary['rank']}.prom"
+        str(tmp_path / "prom"),
+        f"tpusnap_{get_job_id()}_rank{summary['rank']}.prom",
     )
     families = parse_prometheus_textfile(open(prom_file).read())
     assert families["tpusnap_compress_bytes_in_total"]["samples"]

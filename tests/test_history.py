@@ -430,3 +430,152 @@ def test_event_from_summary_carries_write_latency_quantiles():
     # No histograms -> no fields (old events stay shaped as before).
     ev2 = hist.event_from_summary("take", {"take_wall_s": 1.0})
     assert "storage_write_p99_s" not in ev2
+
+
+# --------------------------------------------------------- job identity
+
+
+def test_events_carry_explicit_job_id_only(tmp_path, history_env):
+    from tpusnap.knobs import override_job_id
+
+    with override_job_id(None):
+        Snapshot.take(str(tmp_path / "s1"), {"m": PytreeState(_state())})
+    with override_job_id("exp-a"):
+        Snapshot.take(str(tmp_path / "s2"), {"m": PytreeState(_state())})
+    anon, named = load_history()
+    # The host-pid DEFAULT is deliberately absent from history: it
+    # changes every process and would empty every cross-run baseline.
+    assert anon.get("job_id") is None
+    assert named["job_id"] == "exp-a"
+
+
+def test_check_regression_separates_job_ids():
+    """Two named jobs interleaved in one shared history must never
+    grade against each other; absent ids stay comparable (old
+    histories keep their baselines)."""
+    events = [_synth(i, 4.0, job_id="fast-job") for i in range(8)]
+    events += [_synth(10 + i, 1.0, job_id="slow-job") for i in range(4)]
+    # slow-job's latest 1.0 is healthy against ITS OWN 1.0 baseline —
+    # pooling with fast-job's 4.0s would flag a phantom regression.
+    r = check_regression(events, threshold=0.25)
+    assert r.ok and not r.regressed
+    assert r.n_baseline == 3
+    # A real within-job regression still flags.
+    events.append(_synth(20, 0.3, job_id="slow-job"))
+    r = check_regression(events, threshold=0.25)
+    assert r.regressed and r.baseline_median == pytest.approx(1.0)
+    # Absent job_id (pre-knob histories + unset knob) stays one
+    # comparable population.
+    legacy = [_synth(i, 1.0) for i in range(6)] + [_synth(6, 0.5)]
+    r = check_regression(legacy, threshold=0.25)
+    assert r.regressed
+
+
+# ----------------------------------------------- concurrent-append soak
+
+
+_SOAK_CHILD = r"""
+import os, sys, time
+from tpusnap.history import record_event
+
+path = sys.argv[1]
+writer = int(sys.argv[2])
+n = int(sys.argv[3])
+for i in range(n):
+    ev = {
+        "v": 1,
+        "ts": 1e9 + writer * 10000 + i,
+        "kind": "soak",
+        "rank": 0,
+        "writer": writer,
+        "i": i,
+        "pad": "x" * 120,
+    }
+    assert record_event(ev, path=path) is not None
+print("DONE", writer)
+"""
+
+
+def _run_soak_writers(path, n_writers, n_events, env):
+    import subprocess
+    import sys as _sys
+
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, "-c", _SOAK_CHILD, path, str(w), str(n_events)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for w in range(n_writers)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, err[-800:]
+        assert "DONE" in out
+
+
+def _parse_all_lines(path):
+    """Every line in the file must be a whole JSON event — the torn/
+    interleaved-write failure mode this soak hunts."""
+    events = []
+    with open(path, "rb") as f:
+        for ln in f.read().split(b"\n"):
+            if not ln.strip():
+                continue
+            events.append(json.loads(ln))  # raises on any corrupt line
+    return events
+
+
+@pytest.mark.chaos
+def test_concurrent_append_soak_no_corruption(tmp_path):
+    """N processes hammering one history.jsonl via O_APPEND: every
+    event lands exactly once, no interleaved or torn lines."""
+    import os as _os
+
+    path = str(tmp_path / "tele" / "history.jsonl")
+    env = dict(
+        _os.environ,
+        JAX_PLATFORMS="cpu",
+        TPUSNAP_HISTORY_MAX_BYTES=str(8 << 20),  # bound never trips
+    )
+    n_writers, n_events = 6, 40
+    _run_soak_writers(path, n_writers, n_events, env)
+    events = _parse_all_lines(path)
+    assert len(events) == n_writers * n_events
+    seen = {(e["writer"], e["i"]) for e in events}
+    assert len(seen) == n_writers * n_events  # exactly once each
+    for e in events:
+        assert e["pad"] == "x" * 120  # payload intact, not spliced
+
+
+@pytest.mark.chaos
+def test_concurrent_append_soak_with_compaction(tmp_path):
+    """Same soak with the size bound small enough that compaction runs
+    CONCURRENTLY with other writers: every surviving line is still a
+    whole, bit-exact event (compaction never keeps a torn line or
+    tears a complete one), and the newest events survive it."""
+    import os as _os
+
+    path = str(tmp_path / "tele" / "history.jsonl")
+    env = dict(
+        _os.environ,
+        JAX_PLATFORMS="cpu",
+        # Knob floor is 64 KiB; ~170 B/event x 6 x 120 ≈ 120 KiB total,
+        # so the bound trips repeatedly mid-soak.
+        TPUSNAP_HISTORY_MAX_BYTES="1",
+    )
+    n_writers, n_events = 6, 120
+    _run_soak_writers(path, n_writers, n_events, env)
+    events = _parse_all_lines(path)
+    assert events, "compaction must keep the newest lines"
+    assert os.path.getsize(path) <= 64 * 1024 + 32 * 1024
+    for e in events:
+        assert e["kind"] == "soak"
+        assert 0 <= e["writer"] < n_writers and 0 <= e["i"] < n_events
+        assert e["pad"] == "x" * 120
+    # The newest whole events survive: at least one writer's final
+    # event (the last appends happen after the last compaction).
+    finals = {(e["writer"], e["i"]) for e in events}
+    assert any((w, n_events - 1) in finals for w in range(n_writers))

@@ -51,7 +51,12 @@ def metrics_env(tmp_path):
 
 
 def _prom_path(mdir, rank=0):
-    return os.path.join(mdir, f"tpusnap_rank{rank}.prom")
+    # The default filename carries the job id (collision fix for two
+    # jobs sharing one textfile dir) — host-pid derived unless
+    # TPUSNAP_JOB_ID is set.
+    from tpusnap.knobs import get_job_id
+
+    return os.path.join(mdir, f"tpusnap_{get_job_id()}_rank{rank}.prom")
 
 
 def _jsonl_events(mdir):
@@ -184,7 +189,7 @@ def test_prom_sink_direct_use(tmp_path):
             "gauges": {"scheduler.budget_used_bytes": 1024.0},
         }
     )
-    text = open(os.path.join(tmp_path, "tpusnap_rank3.prom")).read()
+    text = open(_prom_path(tmp_path, rank=3)).read()
     parsed = parse_prometheus_textfile(text)
     samples = parsed["tpusnap_take_seconds"]["samples"]
     assert list(samples.values()) == [1.5]
@@ -204,9 +209,7 @@ def test_prom_sink_ignores_aborted_summaries(tmp_path):
     sink.on_take_summary(
         {"rank": 0, "take_wall_s": 0.2, "counters": {}}  # aborted
     )
-    parsed = parse_prometheus_textfile(
-        open(os.path.join(tmp_path, "tpusnap_rank0.prom")).read()
-    )
+    parsed = parse_prometheus_textfile(open(_prom_path(tmp_path)).read())
     assert list(parsed["tpusnap_take_seconds"]["samples"].values()) == [1.5]
     assert list(parsed["tpusnap_takes_total"]["samples"].values()) == [1]
 

@@ -448,6 +448,9 @@ def test_prometheus_exposition_covers_slo_gauges(slo_env):
     sink.on_slo_update(state)
     text = open(sink.path(0)).read()
     parsed = parse_prometheus_textfile(text)
+    from tpusnap.knobs import get_job_id
+
+    job = get_job_id()
     for fam, local, fleet in (
         ("tpusnap_rpo_seconds", 12.5, 99.0),
         ("tpusnap_data_at_risk_bytes", float(1 << 20), float(1 << 22)),
@@ -456,12 +459,12 @@ def test_prometheus_exposition_covers_slo_gauges(slo_env):
     ):
         samples = parsed[fam]["samples"]
         assert parsed[fam]["type"] == "gauge"
-        assert samples['{rank="0"}'] == local
+        assert samples[f'{{job="{job}",rank="0"}}'] == local
         if fleet is not None:
-            assert samples['{rank="0",scope="fleet"}'] == fleet
+            assert samples[f'{{job="{job}",rank="0",scope="fleet"}}'] == fleet
     breach = parsed["tpusnap_slo_breach"]["samples"]
-    assert breach['{objective="rpo",rank="0"}'] == 1.0
-    assert breach['{objective="rto",rank="0"}'] == 0.0
+    assert breach[f'{{job="{job}",objective="rpo",rank="0"}}'] == 1.0
+    assert breach[f'{{job="{job}",objective="rto",rank="0"}}'] == 0.0
 
 
 def test_fleet_fold_takes_worst_rank(slo_env):
@@ -675,6 +678,9 @@ def test_crash_matrix_data_at_risk_and_rto_accuracy(tmp_path):
         torn = str(tmp_path / "torn")
         crash_env = dict(
             env,
+            # Pin the job id: the prom filename carries it, and the
+            # child's host-pid default is unknowable from here.
+            TPUSNAP_JOB_ID="slocrash",
             TPUSNAP_FAULT_SPEC="latency_ms=150,crash_after_op=write:5",
             # Serialize the writes (one ~256 KB blob in flight at a
             # time): concurrent dispatch would complete all 8 writes in
@@ -691,9 +697,11 @@ def test_crash_matrix_data_at_risk_and_rto_accuracy(tmp_path):
         )
         assert r.returncode == -signal.SIGKILL, r.stderr[-500:]
 
-        prom = open(os.path.join(mdir, "tpusnap_rank0.prom")).read()
+        prom = open(os.path.join(mdir, "tpusnap_slocrash_rank0.prom")).read()
         parsed = parse_prometheus_textfile(prom)
-        at_risk = parsed["tpusnap_data_at_risk_bytes"]["samples"]['{rank="0"}']
+        at_risk = parsed["tpusnap_data_at_risk_bytes"]["samples"][
+            '{job="slocrash",rank="0"}'
+        ]
         est_samples = parsed.get("tpusnap_estimated_rto_seconds", {}).get(
             "samples", {}
         )
@@ -701,7 +709,7 @@ def test_crash_matrix_data_at_risk_and_rto_accuracy(tmp_path):
             "pre-crash prom carries no RTO estimate despite 3 restore "
             "events in history"
         )
-        est_rto = est_samples['{rank="0"}']
+        est_rto = est_samples['{job="slocrash",rank="0"}']
 
         # (a) Pre-kill data-at-risk = the take's full planned payload
         # (nothing was committed), which must equal what the salvage

@@ -1220,8 +1220,10 @@ def test_prom_sink_exports_tier_gauges_and_exhausted_family(tmp_path):
     telemetry.incr("retry.exhausted.write")
     text = sink.render()
     metrics = parse_prometheus_textfile(text)
+    from tpusnap.knobs import get_job_id
+
     assert metrics["tpusnap_upload_lag_bytes"]["samples"] == {
-        '{rank="0"}': 12345.0
+        f'{{job="{get_job_id()}",rank="0"}}': 12345.0
     }
     assert list(metrics["tpusnap_upload_lag_seconds"]["samples"].values()) == [
         6.5

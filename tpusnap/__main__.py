@@ -112,6 +112,22 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
                         exist or an RTO objective is set but no
                         estimate could be formed)
 
+  fleet                 cross-job fleet status from the shared
+                        TPUSNAP_FLEET_DIR every instrumented job's rank
+                        0 mirrors its heartbeat/SLO/tier state into:
+                        per-job table (state, since-commit exposure,
+                        data-at-risk, upload lag, degraded/paused/dead
+                        flags) plus the fleet rollup — worst-case RPO
+                        and at-risk across jobs, aggregate upload lag,
+                        cross-job merged storage-latency quantiles
+                        (``--json`` for machines; ``--prom-out`` writes
+                        scope="fleet" Prometheus families; ``--check``
+                        gates: exit 0 healthy, 2 when worst RPO /
+                        aggregate lag / merged write p99-over-p50 ratio
+                        crosses a threshold, 3 when the fleet dir holds
+                        no records; ``watch --fleet`` tails the same
+                        directory live)
+
   lint                  AST invariant checker over the package source
                         (``tpusnap/devtools/lint.py``): knob access only
                         through knobs.py, monotonic-only clocks,
@@ -126,10 +142,11 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
 
 Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
 (or provably-different diff; history --check: regression; analyze
---check: warn-severity finding; slo --check: SLO breach), 3
-undecidable/unverifiable (or no telemetry recorded — trace and analyze;
-no flight data — timeline; fsck: empty/foreign; history: no/
-insufficient events; slo: no records / no estimator verdict), 4 torn
+--check: warn-severity finding; slo --check: SLO breach; fleet --check:
+fleet objective breach), 3 undecidable/unverifiable (or no telemetry
+recorded — trace and analyze; no flight data — timeline; fsck:
+empty/foreign; history: no/insufficient events; slo: no records / no
+estimator verdict; fleet: no status records), 4 torn
 take (fsck — salvageable by retaking the path; timeline: uncommitted
 path, post-mortem verdict printed).
 """
@@ -1297,6 +1314,16 @@ def cmd_watch(args) -> int:
     import os
     import time
 
+    if args.fleet:
+        return _watch_fleet(args)
+    if not args.path:
+        print(
+            "error: watch needs a snapshot PATH (or --fleet to tail "
+            "the cross-job fleet directory)",
+            file=sys.stderr,
+        )
+        return 1
+
     from .progress import (
         local_root_of,
         read_progress_records,
@@ -1664,6 +1691,175 @@ def cmd_slo(args) -> int:
     return 0
 
 
+def _render_fleet_table(rollup: dict) -> str:
+    """Per-job fleet status table (shared by ``fleet`` and ``watch
+    --fleet``)."""
+    lines = [
+        f"{'job':<22} {'state':<10} {'phase':<10} {'%':>5} "
+        f"{'since-commit':>13} {'at-risk':>9} {'lag':>9} {'rec-age':>8}"
+        "  flags"
+    ]
+    for j in rollup.get("jobs") or []:
+        flags = []
+        if j.get("degraded"):
+            flags.append("DEGRADED")
+        if j.get("paused"):
+            flags.append("PAUSED")
+        if j.get("dead_ranks"):
+            flags.append(
+                "dead:" + ",".join(str(r) for r in j["dead_ranks"])
+            )
+        pct = j.get("percent")
+        lines.append(
+            f"{str(j.get('job_id'))[:22]:<22} {j.get('state') or '?':<10} "
+            f"{str(j.get('phase') or '-')[:10]:<10} "
+            f"{(f'{pct:.0f}' if pct is not None else '-'):>5} "
+            f"{_fmt_age(j.get('rpo_s') or 0):>13} "
+            f"{_fmt_bytes(j.get('data_at_risk_bytes') or 0):>9} "
+            f"{_fmt_bytes(j.get('lag_bytes') or 0):>9} "
+            f"{_fmt_age(j.get('age_s') or 0):>8}  "
+            f"{' '.join(flags) or '-'}"
+        )
+    return "\n".join(lines)
+
+
+def _fleet_summary_lines(rollup: dict) -> str:
+    """The cross-job rollup footer under the per-job table."""
+    worst = rollup.get("worst_rpo_s")
+    parts = [
+        f"{rollup.get('n_jobs', 0)} job(s), "
+        f"{rollup.get('writers', 0)} writing, "
+        f"{rollup.get('degraded_jobs', 0)} degraded, "
+        f"{rollup.get('paused_jobs', 0)} paused, "
+        f"{rollup.get('dead_ranks', 0)} dead rank(s)"
+    ]
+    if worst is not None:
+        parts.append(
+            f"worst RPO {_fmt_age(worst)} ({rollup.get('worst_rpo_job')}), "
+            f"{_fmt_bytes(rollup.get('worst_data_at_risk_bytes') or 0)} at "
+            "risk"
+        )
+    parts.append(
+        f"upload lag {_fmt_bytes(rollup.get('lag_bytes_total') or 0)} "
+        f"(oldest {_fmt_age(rollup.get('lag_seconds_max') or 0)})"
+    )
+    w = (rollup.get("storage") or {}).get("write") or {}
+    if w.get("count"):
+        parts.append(
+            f"storage write p50 {_fmt_seconds(w.get('p50_s'))} / "
+            f"p99 {_fmt_seconds(w.get('p99_s'))} over {w['count']} op(s) "
+            "(merged across jobs)"
+        )
+    return "\n".join("fleet:      " + p for p in parts)
+
+
+def cmd_fleet(args) -> int:
+    import json as _json
+
+    from .fleet import (
+        evaluate_fleet,
+        fold_fleet,
+        read_fleet_records,
+        write_fleet_prom,
+    )
+    from .knobs import get_fleet_dir
+
+    directory = args.dir or get_fleet_dir()
+    if not directory:
+        print(
+            "error: no fleet directory (set TPUSNAP_FLEET_DIR or pass "
+            "--dir)",
+            file=sys.stderr,
+        )
+        return 1
+    records = read_fleet_records(directory)
+    rollup = fold_fleet(records)
+    report = evaluate_fleet(
+        rollup,
+        rpo_threshold_s=args.rpo,
+        lag_bytes_threshold=args.lag_bytes,
+        lag_seconds_threshold=args.lag_s,
+        p99_ratio_threshold=args.p99_ratio,
+    )
+    if args.prom_out:
+        write_fleet_prom(rollup, args.prom_out)
+    if args.json:
+        print(_json.dumps({"dir": directory, "rollup": rollup, **report}))
+    else:
+        print(f"fleet dir:  {directory}")
+        th = report["thresholds"]
+        print(
+            "thresholds: "
+            f"rpo={'%gs' % th['rpo_s'] if th['rpo_s'] else 'unset'} "
+            f"lag_bytes={th['lag_bytes'] or 'unset'} "
+            f"lag_s={'%gs' % th['lag_seconds'] if th['lag_seconds'] else 'unset'} "
+            f"p99_ratio={'%gx' % th['p99_ratio'] if th['p99_ratio'] else 'unset'}"
+        )
+        if records:
+            print()
+            print(_render_fleet_table(rollup))
+            print(_fleet_summary_lines(rollup))
+        print(f"\n{report['verdict'].upper()}: {report['reason']}")
+    # Without records there is nothing to render in any mode (exit 3,
+    # like slo/watch). The 2-on-breach leg is gate semantics under
+    # --check only.
+    if not records:
+        return 3
+    if args.check and report["verdict"] == "breach":
+        return 2
+    return 0
+
+
+def _watch_fleet(args) -> int:
+    """``watch --fleet``: tail the shared fleet directory instead of one
+    take's heartbeat files — one row per JOB, refreshed in place."""
+    import json as _json
+    import time
+
+    from .fleet import fold_fleet, read_fleet_records
+    from .knobs import get_fleet_dir
+
+    directory = args.path or get_fleet_dir()
+    if not directory:
+        print(
+            "error: no fleet directory (set TPUSNAP_FLEET_DIR, or "
+            "`watch --fleet DIR`)",
+            file=sys.stderr,
+        )
+        return 1
+    deadline = (
+        time.monotonic() + args.max_seconds if args.max_seconds else None
+    )
+    interactive = sys.stdout.isatty() and not args.once and not args.json
+    prev_lines = 0
+    seen_records = False
+    while True:
+        records = read_fleet_records(directory)
+        rollup = fold_fleet(records)
+        if records:
+            seen_records = True
+        if args.json:
+            print(_json.dumps({"dir": directory, "rollup": rollup}))
+            return 0 if records else 3
+        frame = _render_fleet_table(rollup)
+        if records:
+            frame += "\n" + _fleet_summary_lines(rollup)
+        else:
+            frame += f"\n(no fleet status records in {directory})"
+        if interactive and prev_lines:
+            # Refresh in place: move the cursor back over the last frame.
+            sys.stdout.write(f"\x1b[{prev_lines}F\x1b[J")
+        print(frame, flush=True)
+        prev_lines = frame.count("\n") + 1
+        if args.once:
+            return 0 if records else 3
+        # A fleet is open-ended (jobs come and go) — unlike the per-take
+        # watch there is no commit to wait for; run until the deadline.
+        if deadline is not None and time.monotonic() > deadline:
+            return 0 if seen_records else 3
+        time.sleep(args.interval)
+
+
 def cmd_cat(args) -> int:
     out = Snapshot(args.path).read_object(args.manifest_path)
     if isinstance(out, np.ndarray):
@@ -1749,9 +1945,20 @@ def main(argv=None) -> int:
     p = sub.add_parser(
         "watch",
         help="live per-rank progress table of an in-flight take "
-        f"(tails {PROGRESS_DIR}/ heartbeat records)",
+        f"(tails {PROGRESS_DIR}/ heartbeat records); --fleet tails the "
+        "cross-job fleet directory instead (one row per JOB)",
     )
-    p.add_argument("path")
+    p.add_argument(
+        "path", nargs="?", default=None,
+        help="snapshot path (with --fleet: the fleet directory, "
+        "default TPUSNAP_FLEET_DIR)",
+    )
+    p.add_argument(
+        "--fleet", action="store_true",
+        help="tail the shared fleet directory (TPUSNAP_FLEET_DIR or "
+        "PATH): per-job state, since-commit exposure, upload lag, "
+        "degraded/paused flags",
+    )
     p.add_argument(
         "--interval", type=float, default=1.0, metavar="S",
         help="refresh interval in seconds (default 1.0)",
@@ -1785,9 +1992,10 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--kind", default="take",
-        choices=["take", "restore", "bench", "orbax", "all"],
+        choices=["take", "restore", "bench", "orbax", "fleet", "all"],
         help="event kind to show/check (default take; orbax = the "
-        "orbax_compare benchmark's median/speedup events)",
+        "orbax_compare benchmark's median/speedup events; fleet = "
+        "fleetsim soak events)",
     )
     p.add_argument(
         "-n", "--limit", type=int, default=20, metavar="N",
@@ -1999,6 +2207,55 @@ def main(argv=None) -> int:
         "records exist or an RTO objective has no estimate, 0 healthy",
     )
     p.set_defaults(fn=cmd_slo)
+
+    p = sub.add_parser(
+        "fleet",
+        help="cross-job fleet status from the shared TPUSNAP_FLEET_DIR "
+        "(per-job table, worst-case RPO/at-risk fold, aggregate upload "
+        "lag, merged storage latency); --check gates (exit 2 breach / "
+        "3 no records)",
+    )
+    p.add_argument(
+        "--dir", default=None, metavar="DIR",
+        help="fleet status directory (default: TPUSNAP_FLEET_DIR)",
+    )
+    p.add_argument(
+        "--rpo", type=float, default=None, metavar="S",
+        help="worst-job RPO threshold in seconds (default: "
+        "TPUSNAP_SLO_RPO_S; 0/unset = no RPO objective)",
+    )
+    p.add_argument(
+        "--lag-bytes", type=int, default=None, metavar="N",
+        dest="lag_bytes",
+        help="aggregate upload-lag threshold in bytes summed across "
+        "jobs (default: no objective)",
+    )
+    p.add_argument(
+        "--lag-s", type=float, default=None, metavar="S", dest="lag_s",
+        help="upload-lag age threshold in seconds — the fleet's oldest "
+        "undurable commit (default: no objective)",
+    )
+    p.add_argument(
+        "--p99-ratio", type=float, default=None, metavar="R",
+        dest="p99_ratio",
+        help="breach when the cross-job merged storage write p99 "
+        "exceeds R x its p50 (default: no objective)",
+    )
+    p.add_argument(
+        "--prom-out", default=None, metavar="PATH", dest="prom_out",
+        help="also write the rollup as scope=\"fleet\" Prometheus "
+        "families to PATH (atomic; point into a node collector's "
+        "textfile directory)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="gate mode: exit 2 on a breached fleet objective, 3 when "
+        "no status records exist, 0 healthy",
+    )
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser(
         "lint",

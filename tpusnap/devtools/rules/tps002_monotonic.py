@@ -27,6 +27,7 @@ SCOPED_MODULES = {
     "flight.py",
     "slo.py",
     "liveness.py",
+    "fleet.py",
 }
 
 

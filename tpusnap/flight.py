@@ -59,6 +59,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .io_types import FLIGHT_DIR
 from .knobs import (
+    get_job_id,
     get_flight_flush_interval_s,
     get_flight_ring_size,
     get_telemetry_dir,
@@ -324,6 +325,7 @@ class FlightRecorder:
             "k": "meta",
             "v": 1,
             "rank": self.rank,
+            "job_id": get_job_id(),
             "take_id": self.take_id,
             "world_size": self.world_size,
             "pid": os.getpid(),

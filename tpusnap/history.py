@@ -53,6 +53,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from .knobs import (
+    get_explicit_job_id,
     get_history_max_bytes,
     get_telemetry_dir,
     is_history_enabled,
@@ -102,6 +103,13 @@ def event_from_summary(kind: str, summary: Dict[str, Any]) -> Dict[str, Any]:
         "kind": kind,
         "rank": summary.get("rank", 0),
         "world_size": summary.get("world_size", 1),
+        # Job identity: two named jobs (TPUSNAP_JOB_ID) sharing one
+        # telemetry dir interleave events in the same history.jsonl,
+        # and the regression baseline filters on this so they never
+        # grade against each other. Deliberately the EXPLICIT id only —
+        # the host-pid default changes every process and would empty
+        # every cross-run baseline.
+        "job_id": get_explicit_job_id(),
         "take_id": summary.get("take_id"),
         "path": summary.get("path"),
         "wall_s": round(wall, 6),
@@ -458,6 +466,11 @@ def check_regression(
         and isinstance(e.get(metric), (int, float))
         and e.get("world_size", 1) == latest.get("world_size", 1)
         and bool(e.get("incremental")) == bool(latest.get("incremental"))
+        # Same comparability stance as kind/world_size: two different
+        # jobs' runs interleaved in a shared telemetry dir must never
+        # grade against each other (pre-job_id events are all None,
+        # which keeps old histories self-comparable).
+        and e.get("job_id") == latest.get("job_id")
     ][-window:]
     if len(baseline_vals) < max(1, min_baseline):
         if cold_latest:

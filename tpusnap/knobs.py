@@ -64,6 +64,8 @@ _COMPRESS_MIN_BLOB_ENV_VAR = "TPUSNAP_COMPRESS_MIN_BLOB_BYTES"
 _BARRIER_TIMEOUT_ENV_VAR = "TPUSNAP_BARRIER_TIMEOUT_S"
 _LIVENESS_TTL_ENV_VAR = "TPUSNAP_LIVENESS_TTL_S"
 _RANK_FAILURE_ENV_VAR = "TPUSNAP_RANK_FAILURE"
+_JOB_ID_ENV_VAR = "TPUSNAP_JOB_ID"
+_FLEET_DIR_ENV_VAR = "TPUSNAP_FLEET_DIR"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -741,6 +743,51 @@ def get_node_name() -> str:
     return os.environ.get(_NODE_NAME_ENV_VAR) or socket.gethostname()
 
 
+def get_job_id() -> str:
+    """The identity of THIS training job on every observability
+    artifact — telemetry summaries, history events, heartbeat records,
+    flight headers, SLO sidecars, Prometheus filenames/labels, and the
+    fleet status records under ``TPUSNAP_FLEET_DIR``. Defaults to
+    ``<node>-<pid>`` so two jobs sharing a telemetry/metrics/fleet
+    directory never collide even when nobody set the knob; a
+    MULTI-PROCESS job must set ``TPUSNAP_JOB_ID`` identically on every
+    rank (the host-pid default would split one job into per-rank
+    identities). Sanitized to filename/label-safe characters: the id
+    lands in file names and Prometheus label values."""
+    explicit = get_explicit_job_id()
+    if explicit is not None:
+        return explicit
+    raw = f"{get_node_name()}-{os.getpid()}"
+    clean = "".join(c if (c.isalnum() or c in "._-") else "-" for c in raw)
+    return clean or "job"
+
+
+def get_explicit_job_id() -> Optional[str]:
+    """``TPUSNAP_JOB_ID`` exactly as configured (sanitized), or None
+    when unset — the comparability key history's regression baseline
+    filters on. :func:`get_job_id`'s host-pid DEFAULT is deliberately
+    absent here: it changes every process, and stamping it into history
+    events would make every cross-run baseline structurally empty
+    (one-take-per-process fleets would never accumulate a gradeable
+    window)."""
+    raw = os.environ.get(_JOB_ID_ENV_VAR)
+    if not raw:
+        return None
+    clean = "".join(c if (c.isalnum() or c in "._-") else "-" for c in raw)
+    return clean or None
+
+
+def get_fleet_dir() -> Optional[str]:
+    """Shared cross-job status directory (``TPUSNAP_FLEET_DIR``): when
+    set, rank 0 of every instrumented job mirrors its heartbeat/SLO/
+    tier state into ``<dir>/<job_id>.json`` (atomic rewrite, riding the
+    heartbeat pump — :mod:`tpusnap.fleet`), and ``python -m tpusnap
+    fleet`` folds all jobs' records into fleet rollups. Unset/empty =
+    the fleet layer is off (zero per-take cost)."""
+    val = os.environ.get(_FLEET_DIR_ENV_VAR)
+    return val or None
+
+
 @contextlib.contextmanager
 def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
     prev = os.environ.get(name)
@@ -1014,6 +1061,21 @@ def override_liveness(
             )
         if policy is not None:
             stack.enter_context(_override_env(_RANK_FAILURE_ENV_VAR, policy))
+        yield
+
+
+@contextlib.contextmanager
+def override_job_id(job_id: Optional[str]) -> Generator[None, None, None]:
+    """Pin (or with ``None``, restore the host-pid default of) the job
+    identity in one scope."""
+    with _override_env(_JOB_ID_ENV_VAR, job_id):
+        yield
+
+
+@contextlib.contextmanager
+def override_fleet_dir(path: Optional[str]) -> Generator[None, None, None]:
+    """Point the fleet status mirror at ``path`` (``None`` disables)."""
+    with _override_env(_FLEET_DIR_ENV_VAR, path):
         yield
 
 

@@ -40,7 +40,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import telemetry
-from .knobs import get_metrics_dir, get_metrics_export
+from .knobs import get_job_id, get_metrics_dir, get_metrics_export
 
 logger = logging.getLogger(__name__)
 
@@ -75,9 +75,12 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
     """Atomic ``.prom`` textfile per rank, rewritten on every
     take/restore summary (never per counter — the textfile collector
     scrapes on its own cadence; rewriting per hot-path increment would
-    be pure churn).
+    be pure churn). The default filename carries the job id
+    (``tpusnap_<job>_rank<k>.prom``) and every sample a ``job`` label
+    (``TPUSNAP_JOB_ID``, host-pid default), so concurrent jobs sharing
+    one metrics directory stay attributable instead of clobbering.
 
-    Exported series (``rank`` label on all):
+    Exported series (``rank`` and ``job`` labels on all):
 
     - ``tpusnap_take_seconds`` / ``tpusnap_restore_seconds`` — gauges,
       last completed take/restore wall-clock.
@@ -153,7 +156,10 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
 
     def path(self, rank: int) -> str:
         d = self._directory or get_metrics_dir()
-        name = self._filename or f"tpusnap_rank{rank}.prom"
+        # The job id is in the default filename so two jobs sharing one
+        # TPUSNAP_METRICS_DIR (a node collector's textfile directory)
+        # can never silently overwrite each other's samples.
+        name = self._filename or f"tpusnap_{get_job_id()}_rank{rank}.prom"
         return os.path.join(d, name)
 
     def _absorb(self, kind: str, summary: Dict[str, Any]) -> None:
@@ -192,6 +198,7 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
         """The full exposition text from current state (process-global
         counters + last summary). Callable without a write for tests."""
         rank = str(self._rank if self._rank is not None else 0)
+        job = get_job_id()
         counters = telemetry.global_counters_snapshot()
         out: List[str] = []
 
@@ -206,6 +213,7 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
             for labels, value in samples:
                 all_labels = dict(labels)
                 all_labels["rank"] = rank
+                all_labels["job"] = job
                 out.append(f"{name}{_fmt_labels(all_labels)} {_fmt_value(value)}")
 
         for kind, mname in (("take", "tpusnap_take_seconds"),

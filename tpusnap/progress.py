@@ -54,6 +54,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from .knobs import (
+    get_job_id,
     get_heartbeat_interval_s,
     get_stall_deadline_s,
     get_telemetry_dir,
@@ -205,6 +206,13 @@ class ProgressMonitor:
         ] = None
         self._clock = clock
         self._wall = wall_clock
+        # Job identity on every published record (cached once: the
+        # host-pid default shells out to gethostname, not a per-tick
+        # cost worth paying).
+        try:
+            self.job_id = get_job_id()
+        except Exception:
+            self.job_id = "job"
         self._state = "running"
         self._bytes_planned = 0
         self._start_t = clock()
@@ -473,6 +481,7 @@ class ProgressMonitor:
             "v": 1,
             "rank": self.rank,
             "world_size": self.world_size,
+            "job_id": self.job_id,
             "take_id": self.take_id,
             "state": self._state,
             "phase": snap["phase"],

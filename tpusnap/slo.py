@@ -71,6 +71,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .knobs import (
     get_heartbeat_interval_s,
+    get_job_id,
     get_slo_rpo_threshold_s,
     get_slo_rto_threshold_s,
     get_slo_stream_cadence_x,
@@ -621,6 +622,7 @@ class SLOTracker:
                 "v": 1,
                 "rank": self.rank,
                 "world_size": self.world_size,
+                "job_id": get_job_id(),
                 "pid": os.getpid(),
                 "ts": self._wall_fn(),
                 "started_ts": self._start_wall,

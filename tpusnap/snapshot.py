@@ -1413,6 +1413,20 @@ def _take_impl(
                 "Failed to attach SLO tracker (non-fatal)", exc_info=True
             )
 
+    # Fleet status mirror (tpusnap.fleet): when TPUSNAP_FLEET_DIR is
+    # set, rank 0 republishes this job's compact status record into the
+    # shared fleet directory on the same tick-hook pump — what
+    # `tpusnap fleet` aggregates across jobs. No-op otherwise.
+    if progress_monitor is not None:
+        try:
+            from . import fleet as _fleet
+
+            _fleet.attach_to_take(progress_monitor)
+        except Exception:
+            logger.warning(
+                "Failed to attach fleet publisher (non-fatal)", exc_info=True
+            )
+
     # Incremental snapshot: this rank's view of the base snapshot's
     # manifest, blob locations rewritten relative to the NEW root.
     prev_entries: Manifest = {}

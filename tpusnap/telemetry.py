@@ -791,6 +791,18 @@ def current() -> Optional[TakeTelemetry]:
     return rec if rec is not None else _global_current
 
 
+def _job_id() -> str:
+    """The job identity every summary carries (``meta["job_id"]`` —
+    concurrent jobs sharing a telemetry/metrics dir stay attributable).
+    Best-effort: identity must never fail a take."""
+    try:
+        from .knobs import get_job_id
+
+        return get_job_id()
+    except Exception:
+        return "job"
+
+
 def _begin_common() -> None:
     # Fresh take/restore: re-arm the one-warning-per-sink budget and
     # reconcile env-driven export sinks (TPUSNAP_METRICS_EXPORT may
@@ -822,6 +834,7 @@ def begin_take(rank: int) -> TakeTelemetry:
         logger.debug("flight ring reset failed", exc_info=True)
     rec = TakeTelemetry(rank)
     rec.meta["kind"] = "take"
+    rec.meta["job_id"] = _job_id()
     _global_current = rec
     return rec
 
@@ -833,6 +846,7 @@ def begin_restore(rank: int) -> TakeTelemetry:
     _begin_common()
     rec = TakeTelemetry(rank)
     rec.meta["kind"] = "restore"
+    rec.meta["job_id"] = _job_id()
     return rec
 
 
