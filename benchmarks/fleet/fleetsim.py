@@ -17,6 +17,15 @@ jobs:
 - a BANDWIDTH CAP starving a fourth's drain, and
 - per-op transient faults on the delta stream.
 
+A shared-base BRANCHING cohort (``--branch``, default 4) rides along:
+four jobs forked from one base checkpoint write mostly-identical
+content through one shared content-addressed store (``TPUSNAP_CAS_DIR``)
+under seeded transient faults. The parent grades the storage bill —
+aggregate store blob bytes must stay within 1.25× ONE job's logical
+bytes (one base + per-job deltas), the store must ``fsck --store``
+clean, and the achieved ``cas_dedup_ratio`` lands in the fleet history
+event so the trend gate catches dedup regressions.
+
 The sim then grades itself with its own tooling: ``python -m tpusnap
 fleet --check`` over the shared fleet dir must be HEALTHY (generous
 thresholds — the seeded faults are survivable by design; only the
@@ -109,6 +118,41 @@ def run_stream(args) -> dict:
     return {"committed": commits, "takes": args.takes}
 
 
+def run_brancher(args) -> dict:
+    """A shared-base branching job: every brancher derives the SAME
+    seeded base weights (four jobs forked from one base checkpoint)
+    plus a tiny per-job delta tensor, and takes through the shared
+    content-addressed store — so the fleet's aggregate store footprint
+    must stay ~1× one job's bytes, not N×."""
+    import numpy as np
+
+    from tpusnap import Snapshot, StateDict
+
+    rng = np.random.default_rng(args.seed)  # NOT + index: shared content
+    n = max(int(args.mb * 1e6) // 4, 1024)
+    state = {
+        "app": StateDict(
+            weights=rng.standard_normal(n).astype(np.float32),
+            delta=np.random.default_rng(1000 + args.index)
+            .standard_normal(256)
+            .astype(np.float32),
+            step=np.int64(0),
+        )
+    }
+    committed = 0
+    for k in range(args.takes):
+        # The base evolves IDENTICALLY across branches (same +1.0 walk
+        # from the same seed): each generation's weights still dedup
+        # store-wide; only each job's small delta tensor is unique.
+        state["app"]["weights"] += np.float32(1.0)
+        state["app"]["step"] = np.int64(k)
+        url = f"chaos+fs://{args.base}/cas_jobs/{args.job}/t{k}"
+        Snapshot.take(url, state)
+        committed += 1
+        time.sleep(args.pause)
+    return {"committed": committed, "takes": args.takes}
+
+
 def run_restorer(args) -> dict:
     """A restore-loop job: seed take, then repeated restores from it
     (the read side of the shared substrate), then one final take so the
@@ -133,7 +177,7 @@ def run_restorer(args) -> dict:
 def child_main(args) -> int:
     t0 = time.time()
     fn = {"trainer": run_trainer, "stream": run_stream,
-          "restore": run_restorer}[args.role]
+          "restore": run_restorer, "branch": run_brancher}[args.role]
     out = {"job": args.job, "role": args.role, "ok": False}
     try:
         out.update(fn(args))
@@ -176,6 +220,14 @@ def spawn_job(args, index: int, role: str, base: str, fleet_dir: str):
             env["TPUSNAP_FAULT_SPEC"] = spec
     elif role == "stream":
         env["TPUSNAP_FAULT_SPEC"] = STREAM_FAULT
+    elif role == "branch":
+        # Branchers share one content-addressed store; their snapshot
+        # side rides seeded transient faults (survivable by design).
+        # Batching is off so the base weights tensor reaches the store
+        # as a dedupable blob instead of a uuid-named slab.
+        env["TPUSNAP_CAS_DIR"] = os.path.join(base, "cas_store")
+        env["TPUSNAP_DISABLE_BATCHING"] = "1"
+        env["TPUSNAP_FAULT_SPEC"] = f"seed={7 + index},transient_per_op=1"
     cmd = [
         sys.executable, os.path.abspath(__file__),
         "--child", "--role", role, "--index", str(index),
@@ -209,6 +261,9 @@ def main() -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--pause", type=float, default=0.2,
                         help="per-step sleep inside each job")
+    parser.add_argument("--branch", type=int, default=4,
+                        help="shared-base branching jobs through one "
+                        "content-addressed store (0 disables; default 4)")
     parser.add_argument("--kill-after", type=int, default=1, dest="kill_after",
                         help="SIGKILL the doomed trainer after its Nth "
                         "remote payload write (per-take plugin "
@@ -242,8 +297,13 @@ def main() -> int:
     ]
     jobs.append(spawn_job(args, n_trainers, "stream", base, fleet_dir))
     jobs.append(spawn_job(args, n_trainers + 1, "restore", base, fleet_dir))
+    for b in range(args.branch):
+        jobs.append(
+            spawn_job(args, n_trainers + 2 + b, "branch", base, fleet_dir)
+        )
     print(f"fleet: {len(jobs)} job(s) under {base} "
-          f"(faults on trainers 0-3 + the stream; trainer 1 is doomed)")
+          f"(faults on trainers 0-3 + the stream; trainer 1 is doomed; "
+          f"{args.branch} branch job(s) share one CAS store)")
 
     # Babysit: SIGCONT the wedged job each poll (a running process
     # ignores SIGCONT, a SIGSTOPped one resumes — bounding the freeze
@@ -315,6 +375,49 @@ def main() -> int:
             f"fleet-records-{rollup.get('n_jobs', 0)}-of-{len(jobs)}"
         )
 
+    # Grade: the shared-base branching scenario's storage bill. The N
+    # branch jobs wrote mostly-identical content through one store, so
+    # the store's blob bytes must stay ~1× one job's logical bytes
+    # (<= 1.25x: one base + per-job deltas + slack), the store must
+    # fsck clean, and the achieved dedup ratio feeds the trend gate.
+    cas_dedup_ratio = None
+    if args.branch:
+        from tpusnap.cas import BLOBS_DIR, read_refs_dir
+
+        cas_store = os.path.join(base, "cas_store")
+        blobs_dir = os.path.join(cas_store, BLOBS_DIR)
+        store_bytes = sum(
+            e.stat().st_size
+            for e in (os.scandir(blobs_dir) if os.path.isdir(blobs_dir) else [])
+            if e.is_file()
+        )
+        logical_bytes = 0
+        for j in jobs:
+            if j["role"] != "branch":
+                continue
+            for k in range(args.takes):
+                snap_dir = os.path.join(base, "cas_jobs", j["job"], f"t{k}")
+                refs, _store = read_refs_dir(snap_dir)
+                logical_bytes += sum(int(rec[0]) for rec in refs.values())
+        cas_dedup_ratio = (
+            round(logical_bytes / store_bytes, 2) if store_bytes else None
+        )
+        budget = 1.25 * (logical_bytes / max(args.branch, 1))
+        print(f"\ncas store: {store_bytes} blob byte(s) for "
+              f"{logical_bytes} logical byte(s) across {args.branch} "
+              f"branch job(s) — dedup ratio {cas_dedup_ratio} "
+              f"(budget {budget:.0f} B)")
+        if store_bytes and store_bytes > budget:
+            failures.append(
+                f"cas-store-{store_bytes}B-over-{budget:.0f}B-budget"
+            )
+        rc_s, _, err_s = cli(["fsck", "--store", cas_store])
+        print(f"fsck --store: rc={rc_s}")
+        if rc_s != 0:
+            failures.append(f"cas-fsck-rc{rc_s}")
+            if err_s.strip():
+                print(err_s.strip())
+
     # Grade 2: record the fleet soak as a kind="fleet" history event and
     # run the trend gate over it (exit 3 = first run, no baseline).
     wall = round(time.time() - t0, 2)
@@ -331,6 +434,9 @@ def main() -> int:
         "worst_rpo_s": rollup.get("worst_rpo_s"),
         "lag_bytes_total": rollup.get("lag_bytes_total"),
         "storage_write_p99_s": w.get("p99_s"),
+        # No _s suffix: higher is better in the trend gate — a dedup
+        # regression (ratio falling toward 1.0) trips history --check.
+        "cas_dedup_ratio": cas_dedup_ratio,
         "wall_s": wall,
     })
     rc_h, out_h, _ = cli(["history", "--check", "--kind", "fleet",

@@ -64,7 +64,13 @@
 #      --check` must honor its full exit contract: 3 on the empty
 #      fleet dir, 0 across the live fleet under generous thresholds,
 #      2 against a seeded stale (non-final, old-commit) job record
-#  13. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
+#  13. CAS smoke — two sequential jobs take identical content through
+#      one shared content-addressed store (TPUSNAP_CAS_DIR): the blobs
+#      dedup to one job's worth, a gc sweep is SIGKILLed mid-delete by
+#      a chaos plan on the store URL, the re-run gc steals the dead
+#      sweeper's lease and converges, and `fsck --store` exits 0 with
+#      the surviving job's refs intact
+#  14. OPTIONAL real-backend cloud suite — when a `fake-gcs-server`
 #      and/or `minio` binary is on PATH, run the `cloud_real` pytest
 #      marker against the real server processes (skipped silently
 #      when the binaries are absent)
@@ -86,14 +92,14 @@ cd "$(dirname "$0")/.."
 fail() { echo "ci_gate: FAIL — $1" >&2; exit "$2"; }
 
 # ---- 1. static analysis --------------------------------------------------
-echo "ci_gate: [1/13] lint --check (AST invariants)"
+echo "ci_gate: [1/14] lint --check (AST invariants)"
 env JAX_PLATFORMS=cpu python -m tpusnap lint --check
 rc=$?
 [ "$rc" -eq 0 ] || fail "tpusnap lint --check (rc=$rc)" "$rc"
 
 # ---- 2. tier-1 -----------------------------------------------------------
 if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
-    echo "ci_gate: [2/13] tier-1 tests"
+    echo "ci_gate: [2/14] tier-1 tests"
     rm -f /tmp/_t1.log
     # cloud_real excluded here: on a host with the server binaries the
     # real-backend suite belongs to step 8, not inside the fast tier.
@@ -104,11 +110,11 @@ if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
     echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
     [ "$rc" -eq 0 ] || fail "tier-1 tests (rc=$rc)" "$rc"
 else
-    echo "ci_gate: [2/13] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
+    echo "ci_gate: [2/14] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
 fi
 
 # ---- 3. cross-run history gate ------------------------------------------
-echo "ci_gate: [3/13] history --check (throughput + p99 write latency)"
+echo "ci_gate: [3/14] history --check (throughput + p99 write latency)"
 for kind in take bench; do
     python -m tpusnap history --check --kind "$kind" \
         --metric throughput_gbps --metric storage_write_p99_s --json
@@ -123,7 +129,7 @@ done
 # ---- 4. analyze doctor on the latest snapshot ---------------------------
 SNAP="${1:-${TPUSNAP_CI_SNAPSHOT:-}}"
 if [ -n "$SNAP" ]; then
-    echo "ci_gate: [4/13] analyze --check $SNAP"
+    echo "ci_gate: [4/14] analyze --check $SNAP"
     python -m tpusnap analyze --check --history "$SNAP"
     rc=$?
     case "$rc" in
@@ -132,11 +138,11 @@ if [ -n "$SNAP" ]; then
         *) fail "analyze --check $SNAP (rc=$rc)" "$rc" ;;
     esac
 else
-    echo "ci_gate: [4/13] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
+    echo "ci_gate: [4/14] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
 fi
 
 # ---- 5. checkpoint-SLO gate smoke ---------------------------------------
-echo "ci_gate: [5/13] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
+echo "ci_gate: [5/14] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, subprocess, sys, tempfile, time
 
@@ -193,7 +199,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "slo --check smoke (rc=$rc)" "$rc"
 
 # ---- 6. delta soak smoke -------------------------------------------------
-echo "ci_gate: [6/13] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
+echo "ci_gate: [6/14] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, re, shutil, signal, subprocess, sys, tempfile, time
 
@@ -337,7 +343,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "delta soak smoke (rc=$rc)" "$rc"
 
 # ---- 7. flight-recorder timeline smoke ----------------------------------
-echo "ci_gate: [7/13] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
+echo "ci_gate: [7/14] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, signal, subprocess, sys, tempfile
 
@@ -411,7 +417,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "timeline smoke (rc=$rc)" "$rc"
 
 # ---- 8. write-back tiering smoke ----------------------------------------
-echo "ci_gate: [8/13] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
+echo "ci_gate: [8/14] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, signal, subprocess, sys, tempfile
 
@@ -501,7 +507,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "tiering smoke (rc=$rc)" "$rc"
 
 # ---- 9. fused-compression smoke ------------------------------------------
-echo "ci_gate: [9/13] compression smoke (compressed take -> fsck/scrub clean -> bit-exact restore; auto bypasses locally, compresses on a throttled pipe)"
+echo "ci_gate: [9/14] compression smoke (compressed take -> fsck/scrub clean -> bit-exact restore; auto bypasses locally, compresses on a throttled pipe)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, sys, tempfile
 
@@ -612,7 +618,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "compression smoke (rc=$rc)" "$rc"
 
 # ---- 10. rank-failure smoke ----------------------------------------------
-echo "ci_gate: [10/13] rank-failure smoke (chaos rank-kill -> fast RankFailedError; degrade-mode replicated take -> committed + scrub clean)"
+echo "ci_gate: [10/14] rank-failure smoke (chaos rank-kill -> fast RankFailedError; degrade-mode replicated take -> committed + scrub clean)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import atexit, os, re, shutil, subprocess, sys, tempfile
 
@@ -758,7 +764,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "rank-failure smoke (rc=$rc)" "$rc"
 
 # ---- 11. elastic-stream smoke ---------------------------------------------
-echo "ci_gate: [11/13] elastic-stream smoke (2-process stream survives a SIGKILLed rank via a degraded epoch; graceful leave + re-join re-plan the world)"
+echo "ci_gate: [11/14] elastic-stream smoke (2-process stream survives a SIGKILLed rank via a degraded epoch; graceful leave + re-join re-plan the world)"
 env JAX_PLATFORMS=cpu TPUSNAP_HISTORY=0 python -m pytest -q \
     tests/test_stream_elastic.py::test_stream_survives_rank_sigkill \
     tests/test_stream_elastic.py::test_stream_graceful_leave_and_rejoin \
@@ -767,7 +773,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "elastic-stream smoke (rc=$rc)" "$rc"
 
 # ---- 12. fleet observability smoke ----------------------------------------
-echo "ci_gate: [12/13] mini-fleetsim smoke (3 jobs, rank-kill + outage faults; fleet --check exit contract: 0 healthy / 2 breach / 3 no records)"
+echo "ci_gate: [12/14] mini-fleetsim smoke (3 jobs, rank-kill + outage faults; fleet --check exit contract: 0 healthy / 2 breach / 3 no records)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import atexit, json, os, shutil, signal, subprocess, sys, tempfile, time
 
@@ -871,9 +877,103 @@ PYEOF
 rc=$?
 [ "$rc" -eq 0 ] || fail "mini-fleetsim smoke (rc=$rc)" "$rc"
 
-# ---- 13. optional real-backend cloud suite -------------------------------
+# ---- 13. content-addressed store smoke ------------------------------------
+echo "ci_gate: [13/14] CAS smoke (two jobs share a base through one store; SIGKILL mid-gc-sweep -> re-run gc converges -> fsck --store exit 0)"
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import atexit, os, shutil, signal, subprocess, sys, tempfile, time
+
+work = tempfile.mkdtemp(prefix="tpusnap_ci_cas_")
+atexit.register(shutil.rmtree, work, True)
+store = os.path.join(work, "store")
+
+def die(msg):
+    print(f"cas-smoke: FAIL - {msg}", file=sys.stderr)
+    sys.exit(1)
+
+def run(cmd, env=None, timeout=120):
+    return subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout,
+        env=env or dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+def cli(*args, env=None):
+    return run([sys.executable, "-m", "tpusnap", *args], env=env)
+
+# (a) two sequential jobs take the SAME content through one shared
+# store: the second job's payload must dedup to refs (blob count stays
+# at one job's worth), both commit, both fsck clean.
+_JOB = (
+    "import os, sys; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+    "import jax; jax.config.update('jax_platforms','cpu')\n"
+    "import numpy as np\n"
+    "from tpusnap import Snapshot, StateDict\n"
+    "rng = np.random.default_rng(7)\n"
+    "state = {'m': StateDict(**{f'w{i}': rng.standard_normal((128, 128))"
+    ".astype(np.float32) for i in range(4)})}\n"
+    "Snapshot.take(sys.argv[1], state)\n"
+)
+env = dict(
+    os.environ, JAX_PLATFORMS="cpu", TPUSNAP_CAS_DIR=store,
+    TPUSNAP_DISABLE_BATCHING="1", TPUSNAP_HISTORY="0",
+    TPUSNAP_TELEMETRY_DIR=os.path.join(work, "tele"),
+)
+for job in ("jobA", "jobB"):
+    r = run([sys.executable, "-c", _JOB, os.path.join(work, job)], env=env)
+    if r.returncode != 0:
+        die(f"{job} take failed: {r.stderr[-400:]}")
+blobs_dir = os.path.join(store, "blobs")
+n_blobs = len(os.listdir(blobs_dir))
+if n_blobs != 4:
+    die(f"expected 4 deduped blobs for 2 jobs x 4 tensors, got {n_blobs}")
+r = cli("fsck", "--store", store)
+if r.returncode != 0:
+    die(f"fsck --store after 2 jobs: expected exit 0, got {r.returncode}: "
+        f"{r.stdout[-300:]}{r.stderr[-300:]}")
+
+# (b) job A retires: its dir goes away, its root record and the now
+# half-orphaned blobs age past the grace window (backdated mtimes).
+shutil.rmtree(os.path.join(work, "jobA"))
+old = time.time() - 3600
+for sub in ("roots", "blobs"):
+    d = os.path.join(store, sub)
+    for name in os.listdir(d):
+        os.utime(os.path.join(d, name), (old, old))
+
+# (c) SIGKILL mid-gc-sweep: a chaos-wrapped store URL kills the sweeper
+# right after its first delete. Its lease is taken with a 1 s TTL so
+# the re-run can steal it.
+chaos_env = dict(
+    env, TPUSNAP_FAULT_SPEC="crash_after_op=delete:1",
+    TPUSNAP_CAS_LEASE_TTL_S="1",
+)
+r = cli("gc", "--store", f"chaos+fs://{store}", "--force", env=chaos_env)
+if r.returncode != -signal.SIGKILL:
+    die(f"chaos gc: expected SIGKILL, got {r.returncode}: {r.stderr[-400:]}")
+time.sleep(1.2)  # let the dead sweeper's lease expire
+
+# (d) re-run gc converges: job A's stale root sweeps, job B's refs keep
+# every blob, and the store fscks clean with zero dangling refs.
+r = cli("gc", "--store", store, "--force", env=env)
+if r.returncode != 0:
+    die(f"gc re-run: expected exit 0, got {r.returncode}: {r.stderr[-400:]}")
+r = cli("fsck", "--store", store)
+if r.returncode != 0:
+    die(f"fsck --store after gc: expected exit 0, got {r.returncode}: "
+        f"{r.stdout[-300:]}{r.stderr[-300:]}")
+if len(os.listdir(blobs_dir)) != 4:
+    die(f"job B's refs must keep all 4 blobs, got {len(os.listdir(blobs_dir))}")
+r = cli("fsck", os.path.join(work, "jobB"), env=env)
+if r.returncode != 0:
+    die(f"job B fsck: expected exit 0, got {r.returncode}: {r.stdout[-300:]}")
+print("cas-smoke: OK (dedup 2 jobs -> 4 blobs; mid-sweep SIGKILL -> "
+      "converged gc -> clean fsck)")
+PYEOF
+rc=$?
+[ "$rc" -eq 0 ] || fail "CAS smoke (rc=$rc)" "$rc"
+
+# ---- 14. optional real-backend cloud suite -------------------------------
 if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&1; then
-    echo "ci_gate: [13/13] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
+    echo "ci_gate: [14/14] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m cloud_real \
         -p no:cacheprovider -p no:xdist -p no:randomly
     rc=$?
@@ -883,7 +983,7 @@ if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&
         fail "real-backend cloud suite (rc=$rc)" "$rc"
     fi
 else
-    echo "ci_gate: [13/13] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
+    echo "ci_gate: [14/14] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
 fi
 
 echo "ci_gate: PASS"

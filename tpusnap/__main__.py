@@ -406,6 +406,29 @@ def cmd_info(args) -> int:
             restore_backend = _rsl(args.path)
     except Exception:
         pass
+    # Content-addressed store refs (tpusnap.cas): how much of this
+    # snapshot's payload lives as shared-store refs instead of private
+    # copies, and which store holds the blobs.
+    try:
+        from .cas import read_refs_dir, resolve_store_url
+        from .tiering import parse_tier_url as _ptu
+
+        _spec = _ptu(args.path)
+        _dir = _spec.local_dir if _spec is not None else args.path
+        cas_refs, cas_store = read_refs_dir(_dir)
+        if cas_refs:
+            dedup = sum(int(r[0]) for r in cas_refs.values())
+            print(
+                f"cas:         {len(cas_refs)} ref(s) into "
+                f"{cas_store or resolve_store_url() or '(unknown store)'}"
+            )
+            print(
+                f"             {_fmt_bytes(dedup)} deduplicated in the "
+                f"store, {_fmt_bytes(max(total - dedup, 0))} materialized "
+                "as private copies"
+            )
+    except Exception:
+        pass
     # History-derived estimated restore time (the tpusnap.slo RTO
     # estimator over the rank-0 restore view): "how long until training
     # resumes from THIS snapshot" — best-effort, shown only when ≥3
@@ -534,6 +557,35 @@ def cmd_retain(args) -> int:
 def cmd_fsck(args) -> int:
     from .lifecycle import fsck_snapshot
 
+    if getattr(args, "store", False):
+        # Store-wide mode. Exit contract: 0 = clean or merely
+        # reclaimable (orphans and torn publishes are NORMAL crash
+        # debris gc converges, not corruption); 4 = dangling ref(s) —
+        # a committed snapshot references a blob the store no longer
+        # holds, restore-breaking; 3 = not a store.
+        from .cas import fsck_store
+
+        srep = fsck_store(args.path)
+        print(srep.summary())
+        if srep.state != "store":
+            print(f"error: {srep.detail}", file=sys.stderr)
+            return 3
+        if args.verbose:
+            for d in srep.dangling:
+                print(
+                    f"DANGLING {d['key']}  ref'd as {d['location']!r} "
+                    f"by root {d['root']}"
+                )
+            for k, sz in sorted(srep.orphans.items()):
+                print(f"ORPHAN   {_fmt_bytes(sz):>10s}  blobs/{k}")
+            for p in srep.torn_publishes:
+                print(f"TORN     {p}")
+            for p in srep.stale_roots:
+                print(f"STALE    {p}  (snapshot dir gone)")
+            for k in srep.refcount_divergence:
+                print(f"DIVERGED refcounts.json[{k}] != mark count")
+        return 4 if srep.dangling else 0
+
     report = fsck_snapshot(args.path)
     if report.state in ("foreign", "empty"):
         # Not a take dir itself — a delta-stream ROOT holds classifiable
@@ -593,15 +645,24 @@ def cmd_fsck(args) -> int:
     if args.verbose:
         for p in report.missing_referenced:
             print(f"MISSING  {p}")
+        for p in report.cas_dangling:
+            print(
+                f"DANGLING {p}  (CAS ref into {report.cas_store}; the "
+                "store no longer holds the blob)"
+            )
         for p in report.evicted:
             print(f"EVICTED  {p}  (remote-durable; restorable from "
                   f"{report.tier_remote})")
         for p, sz in sorted(report.orphans.items()):
             print(f"ORPHAN   {_fmt_bytes(sz):>10s}  {p}")
     # committed→0; corrupt-metadata→2 (corruption, like verify); torn→4
-    # (salvageable — retake the path or `gc --torn`); empty/foreign→3
-    # (nothing tpusnap-shaped to check).
+    # (salvageable — retake the path or `gc --torn`); a committed
+    # snapshot with DANGLING CAS refs→4 (the shared store lost blobs it
+    # needs — `fsck --store` the store for the other side of the
+    # verdict); empty/foreign→3 (nothing tpusnap-shaped to check).
     if report.state == "committed":
+        if report.cas_dangling:
+            return 4
         return 2 if report.missing_referenced else 0
     if report.state == "corrupt-metadata":
         return 2
@@ -618,6 +679,20 @@ def cmd_drain(args) -> int:
         parse_tier_url,
         tier_state_of_dir,
     )
+
+    if getattr(args, "store", False):
+        # Store-wide drain: upload every blob to the store's remote
+        # mirror once store-wide, journaled by hash (a crashed drain
+        # skips everything already proven remote on re-run).
+        from .cas import drain_store
+
+        srep = drain_store(args.path, remote_url=args.remote)
+        for err in srep.errors:
+            print(f"error: {err}", file=sys.stderr)
+        print(srep.summary())
+        if srep.state == "durable":
+            return 0
+        return 3 if srep.state == "no-remote" else 2
 
     spec = parse_tier_url(args.path)
     local_dir = spec.local_dir if spec is not None else args.path
@@ -667,6 +742,23 @@ def cmd_drain(args) -> int:
 
 def cmd_gc(args) -> int:
     from .lifecycle import gc_snapshot
+
+    if getattr(args, "store", False):
+        # Store-wide mark-and-sweep (dry-run unless --force): blobs
+        # referenced by any live root's ref records — or named by a
+        # publish intent younger than the grace window — survive;
+        # everything else past the grace window is swept under the
+        # per-store lock lease.
+        from .cas import gc_store
+
+        srep = gc_store(args.path, dry_run=not args.force)
+        would = "" if args.force else "would "
+        for p, sz in sorted(srep.reclaimed.items()):
+            print(f"{would}delete  {_fmt_bytes(sz):>10s}  {p}")
+        for err in srep.errors:
+            print(f"error: {err}", file=sys.stderr)
+        print(srep.summary())
+        return 1 if srep.errors else 0
 
     report = gc_snapshot(
         args.path,
@@ -1238,6 +1330,21 @@ def cmd_timeline(args) -> int:
     else:
         print(f"path:   {args.path}")
         print(f"state:  {report.state} (fsck)")
+        if report.cas_refs:
+            # CAS verdict line: a post-mortem must say whether the
+            # shared store still backs this snapshot's refs — a
+            # dangling ref is restore-breaking regardless of how
+            # cleanly the take itself committed.
+            print(
+                f"cas:    {report.cas_refs} ref(s) into "
+                f"{report.cas_store}"
+                + (
+                    f" — {len(report.cas_dangling)} DANGLING "
+                    "(the store lost blob(s); `fsck --store` it)"
+                    if report.cas_dangling
+                    else " (all blobs present in the store)"
+                )
+            )
         if report.durability is not None:
             # Write-back tiering: a committed-but-local-only snapshot is
             # one host failure away from losing its only copy — the
@@ -2114,6 +2221,14 @@ def main(argv=None) -> int:
         "-v", "--verbose", action="store_true",
         help="list each orphan/missing file",
     )
+    p.add_argument(
+        "--store", action="store_true",
+        help="treat PATH as a content-addressed STORE directory: "
+        "store-wide verdicts (dangling refs, orphan blobs, torn "
+        "publishes, stale intents/roots, refcount-cache divergence); "
+        "exit 0 clean-or-reclaimable / 4 dangling ref(s) / 3 not a "
+        "store",
+    )
     p.set_defaults(fn=cmd_fsck)
 
     p = sub.add_parser(
@@ -2137,6 +2252,14 @@ def main(argv=None) -> int:
         "the journal stay, reads through the tier URL fall back to the "
         "remote)",
     )
+    p.add_argument(
+        "--store", action="store_true",
+        help="treat PATH as a content-addressed STORE directory: "
+        "mark-and-sweep over ref records (grace window "
+        "TPUSNAP_CAS_GRACE_S, per-store lock lease); sweeps "
+        "unreferenced blobs, torn publishes, stale intents and stale "
+        "roots",
+    )
     p.set_defaults(fn=cmd_gc)
 
     p = sub.add_parser(
@@ -2149,6 +2272,13 @@ def main(argv=None) -> int:
         "path",
         help="tier URL (tier+local=...+remote=...://...) or the local "
         "tier directory (the upload journal names the remote)",
+    )
+    p.add_argument(
+        "--store", action="store_true",
+        help="treat PATH as a content-addressed STORE directory: "
+        "upload each blob ONCE store-wide to the store's remote "
+        "mirror (config.json remote / TPUSNAP_CAS_REMOTE), journaled "
+        "by hash for crash-safe resume",
     )
     p.add_argument(
         "--remote", default=None, metavar="URL",

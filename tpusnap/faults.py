@@ -311,11 +311,16 @@ class FaultInjectionStoragePlugin(StoragePlugin):
         writes by name (``crash_after_op=journal:1``) without the index
         arithmetic drifting as blob counts change; everything else keeps
         the raw op kind. ``list`` (fsck/gc enumeration) is already its
-        own kind."""
+        own kind. CAS ref records get the same treatment
+        (``crash_after_op=cas_ref:1`` kills precisely after the first
+        ref flush — the mid-ref-write chaos window)."""
+        from .io_types import CAS_REFS_DIR
         from .lifecycle import is_journal_path
 
         if is_journal_path(path):
             return "journal"
+        if path.startswith(CAS_REFS_DIR + "/"):
+            return "cas_ref"
         return kind
 
     def _decide(self, kind: str, path: str) -> Tuple[bool, float]:

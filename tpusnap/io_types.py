@@ -53,6 +53,13 @@ FLIGHT_DIR = SIDECAR_PREFIX + "flight"  # flight-recorder event logs
 # of what has been proven remote, plus the durability state marker
 # (state "pending" = local-committed, "durable" = remote-durable).
 UPLOAD_JOURNAL_PATH = SIDECAR_PREFIX + "upload_journal"
+# Content-addressed store (tpusnap.cas): per-rank ref record files a
+# CAS-composed snapshot keeps instead of private payload copies — each
+# entry maps a manifest location to the (nbytes, CRC32C, XXH64) triple
+# that keys the shared blob. The refs ARE the store's gc liveness
+# roots, so they are journaled like PR 3 evidence (atomic per-rank
+# rewrites) and flushed strictly before the metadata commit.
+CAS_REFS_DIR = SIDECAR_PREFIX + "cas_refs"  # per-rank ref records
 
 T = TypeVar("T")
 

@@ -66,6 +66,10 @@ _LIVENESS_TTL_ENV_VAR = "TPUSNAP_LIVENESS_TTL_S"
 _RANK_FAILURE_ENV_VAR = "TPUSNAP_RANK_FAILURE"
 _JOB_ID_ENV_VAR = "TPUSNAP_JOB_ID"
 _FLEET_DIR_ENV_VAR = "TPUSNAP_FLEET_DIR"
+_CAS_DIR_ENV_VAR = "TPUSNAP_CAS_DIR"
+_CAS_GRACE_ENV_VAR = "TPUSNAP_CAS_GRACE_S"
+_CAS_LEASE_TTL_ENV_VAR = "TPUSNAP_CAS_LEASE_TTL_S"
+_CAS_REMOTE_ENV_VAR = "TPUSNAP_CAS_REMOTE"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -788,6 +792,50 @@ def get_fleet_dir() -> Optional[str]:
     return val or None
 
 
+def get_cas_dir() -> Optional[str]:
+    """Shared content-addressed blob store (``TPUSNAP_CAS_DIR``,
+    :mod:`tpusnap.cas`): a directory — or a storage URL, e.g.
+    ``chaos+fs:///store`` so chaos plans can target store I/O — that
+    every CAS-composed take publishes payload blobs into, keyed by
+    their (CRC32C, XXH64) dual hash. When set, a plain ``fs`` take URL
+    is auto-composed with the CAS layer (equivalent to the explicit
+    ``cas+fs://`` scheme); snapshots then hold ref records instead of
+    private payload copies. Unset/empty = the layer is off."""
+    val = os.environ.get(_CAS_DIR_ENV_VAR)
+    return val or None
+
+
+def get_cas_grace_s() -> float:
+    """Grace window of the store's mark-and-sweep gc
+    (:func:`tpusnap.cas.gc_store`): an UNMARKED blob, a stale publish
+    intent, a ``.tmp.*`` torn-publish leftover or a stale root record
+    is swept only once it is at least this old — young debris may be a
+    concurrent publisher mid-adoption whose ref record simply hasn't
+    landed yet. Lowering it below the duration of a take invites the
+    publish-vs-gc race the intent records exist to close."""
+    return max(0.0, _get_float_env(_CAS_GRACE_ENV_VAR, 900.0))
+
+
+def get_cas_lease_ttl_s() -> float:
+    """TTL of the per-store gc lock lease (``gc.lock``): a second
+    ``gc --store`` against the same store is refused while a live lease
+    exists, and a lease abandoned by a SIGKILLed sweeper is stealable
+    once this old (the PR 15 lease shape applied to stores)."""
+    return max(0.5, _get_float_env(_CAS_LEASE_TTL_ENV_VAR, 60.0))
+
+
+def get_cas_remote() -> Optional[str]:
+    """Remote mirror URL of the content-addressed store: when set (or
+    recorded in the store's ``config.json``), the tiering drain uploads
+    each unique store blob ONCE store-wide to ``<remote>/blobs/<key>``
+    — recording dual-hash evidence in the store-level upload journal —
+    and store reads fall back to the mirror for locally-evicted blobs.
+    Unset = the store is local-only (``gc --evict-local`` then refuses
+    to evict CAS-referenced payloads)."""
+    val = os.environ.get(_CAS_REMOTE_ENV_VAR)
+    return val or None
+
+
 @contextlib.contextmanager
 def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
     prev = os.environ.get(name)
@@ -1076,6 +1124,30 @@ def override_job_id(job_id: Optional[str]) -> Generator[None, None, None]:
 def override_fleet_dir(path: Optional[str]) -> Generator[None, None, None]:
     """Point the fleet status mirror at ``path`` (``None`` disables)."""
     with _override_env(_FLEET_DIR_ENV_VAR, path):
+        yield
+
+
+@contextlib.contextmanager
+def override_cas(
+    store_dir: Optional[str],
+    grace_s: Optional[float] = None,
+    lease_ttl_s: Optional[float] = None,
+    remote: Optional[str] = None,
+) -> Generator[None, None, None]:
+    """Point the content-addressed store at ``store_dir`` (``None``
+    disables) with optional gc grace / lease-TTL / remote overrides."""
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(_override_env(_CAS_DIR_ENV_VAR, store_dir))
+        if grace_s is not None:
+            stack.enter_context(
+                _override_env(_CAS_GRACE_ENV_VAR, str(grace_s))
+            )
+        if lease_ttl_s is not None:
+            stack.enter_context(
+                _override_env(_CAS_LEASE_TTL_ENV_VAR, str(lease_ttl_s))
+            )
+        if remote is not None:
+            stack.enter_context(_override_env(_CAS_REMOTE_ENV_VAR, remote))
         yield
 
 

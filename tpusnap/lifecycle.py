@@ -47,6 +47,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from . import _native, flight, telemetry
 from .io_types import (
+    CAS_REFS_DIR,
     FLIGHT_DIR,
     JOURNAL_PATH,
     JOURNAL_RECORDS_DIR,
@@ -454,6 +455,10 @@ class JournalingStoragePlugin(StoragePlugin):
             )
             await self._record(write_io.path, triple)
             return
+        # Hand the fused-pass evidence down the chain: a CAS layer below
+        # keys the shared-store blob on exactly this triple instead of
+        # paying a second hash pass over the same bytes.
+        write_io.dedup_triple = triple
         await self.inner.write(write_io)
         # Completion evidence exists the moment the record lands; the
         # flight event mirrors it so the post-mortem timeline shows
@@ -536,6 +541,17 @@ class FsckReport:
     # the take journal (torn) — what makes a torn tail explainable as
     # "micro-commit N over member X" instead of an anonymous torn take.
     delta: Optional[Dict[str, Any]] = None
+    # Content-addressed store (tpusnap.cas): ref records this snapshot
+    # holds instead of private payload copies. ``cas_resolved`` are
+    # referenced locations whose shared blob the store verifiably holds
+    # (they are NOT missing even though the snapshot dir has no such
+    # file); ``cas_dangling`` are refs whose blob the store has LOST —
+    # restore-breaking, the one CAS state that exits nonzero (4).
+    cas_store: Optional[str] = None
+    cas_refs: int = 0
+    cas_dedup_bytes: int = 0
+    cas_resolved: List[str] = field(default_factory=list)
+    cas_dangling: List[str] = field(default_factory=list)
     # The listing this classification was computed from (None when the
     # backend cannot list) — reused by gc so one fsck+gc pays one walk.
     files: Optional[Dict[str, int]] = field(default=None, repr=False)
@@ -569,6 +585,19 @@ class FsckReport:
                     f" [DEGRADED commit: rank(s) {deg['dead_ranks']} died "
                     "mid-take; their replicated writes were adopted by "
                     "the survivors]"
+                )
+            if self.cas_refs:
+                s += (
+                    f" [CAS: {self.cas_refs} ref(s) into "
+                    f"{self.cas_store or 'unknown store'}, "
+                    f"{self.cas_dedup_bytes} bytes deduplicated"
+                    + (
+                        f"; {len(self.cas_dangling)} DANGLING ref(s) — "
+                        "the store lost blob(s) this snapshot needs"
+                        if self.cas_dangling
+                        else ""
+                    )
+                    + "]"
                 )
             if self.durability is not None:
                 s += f" [{self.durability}"
@@ -632,7 +661,9 @@ def _is_legit_sidecar(path: str) -> bool:
     The take-journal family is NOT legit post-commit (the commit clears
     it), and ``.tmp.<pid>`` debris anywhere — including a SIGKILLed
     journal/telemetry/heartbeat atomic write — is reclaimable, so both
-    count as orphans."""
+    count as orphans. CAS ref records (``.tpusnap/cas_refs/``) are the
+    committed snapshot's claim on its shared-store blobs — deleting one
+    would hand the blob to the store's next sweep."""
     if path == UPLOAD_JOURNAL_PATH:
         return True
     return (
@@ -641,6 +672,7 @@ def _is_legit_sidecar(path: str) -> bool:
                 TELEMETRY_DIR + "/",
                 _PROGRESS_SIDECAR_PREFIX,
                 _FLIGHT_SIDECAR_PREFIX,
+                CAS_REFS_DIR + "/",
             )
         )
         and ".tmp." not in path.rsplit("/", 1)[-1]
@@ -766,10 +798,43 @@ def _fsck_impl(
                 # (fsck the remote URL to verify the cloud copy itself).
                 report.evicted = report.missing_referenced
                 report.missing_referenced = []
+            # Content-addressed refs: a referenced location held as a
+            # CAS ref has no private file here BY DESIGN — resolve it
+            # against the shared store before calling it missing. The
+            # probe is a DEEP store check even when a composed CAS
+            # plugin synthesized the location into the listing: the
+            # synthetic entry proves a ref exists, not that the store
+            # still holds the blob (a sweep may have raced it away —
+            # the one restore-breaking CAS state, "dangling ref").
+            from .cas import blob_exists_in_store, blob_key as _cas_key
+            from .cas import read_refs, resolve_store_url
+
+            cas_refs, cas_store = read_refs(storage, event_loop)
+            if cas_refs:
+                report.cas_store = cas_store or resolve_store_url()
+                report.cas_refs = len(cas_refs)
+                missing = set(report.missing_referenced)
+                for loc in sorted(set(cas_refs) & referenced):
+                    rec = cas_refs[loc]
+                    missing.discard(loc)
+                    if blob_exists_in_store(
+                        report.cas_store, _cas_key(tuple(rec))
+                    ):
+                        report.cas_resolved.append(loc)
+                        report.cas_dedup_bytes += int(rec[0])
+                    else:
+                        report.cas_dangling.append(loc)
+                report.missing_referenced = sorted(missing)
             if report.missing_referenced:
                 report.detail = (
                     f"{len(report.missing_referenced)} referenced blob(s) "
                     "missing from storage — the snapshot will not restore"
+                )
+            if report.cas_dangling:
+                report.detail = (
+                    f"{len(report.cas_dangling)} CAS ref(s) DANGLING — "
+                    f"the store at {report.cas_store!r} no longer holds "
+                    "their blobs; the snapshot will not restore"
                 )
             report.orphans = {
                 p: sz
@@ -931,13 +996,50 @@ def _evictable_local_blobs(
         _referenced_locations(fsck.metadata) if fsck.metadata else set()
     )
     files = fsck.files or {}
-    return {
+    evictable = {
         p: sz
         for p, sz in sorted(files.items())
         if p in referenced
         and p != SNAPSHOT_METADATA_FNAME
         and not p.startswith(_SIDECAR_PREFIX)
     }
+    if fsck.cas_refs:
+        # CAS interplay: payload locations held as refs occupy no local
+        # bytes here — their blobs live in the SHARED store, and this
+        # snapshot's own upload journal proves nothing about them. A
+        # composed listing synthesizes them into ``files``, so naive
+        # eviction would delete the REF (dropping the gc liveness root
+        # other restores rely on). Exclude them — and refuse outright
+        # unless the store's upload journal proves every ref'd blob
+        # remote: post-eviction this directory restores from remotes,
+        # and a ref'd blob with no store-remote evidence would have NO
+        # durable copy backing this snapshot's claim.
+        from .cas import blob_key as _cas_key
+        from .cas import read_refs, resolve_store_url, store_remote_evidence
+
+        cas_refs, cas_store = read_refs(storage, event_loop)
+        store_url = cas_store or resolve_store_url()
+        ref_locs = set(cas_refs) & referenced
+        keys = {_cas_key(tuple(cas_refs[loc])) for loc in ref_locs}
+        proven, _remote = (
+            store_remote_evidence(store_url, keys)
+            if store_url
+            else (set(), None)
+        )
+        unproven = sorted(keys - proven)
+        if unproven:
+            raise RuntimeError(
+                f"{path!r} holds {len(ref_locs)} CAS ref(s) into "
+                f"{store_url!r} but the STORE's upload journal proves "
+                f"only {len(proven)}/{len(keys)} of their blobs remote "
+                "— a snapshot's own durable marker does not cover "
+                "shared blobs; run `tpusnap drain --store` to "
+                "convergence first"
+            )
+        evictable = {
+            p: sz for p, sz in evictable.items() if p not in ref_locs
+        }
+    return evictable
 
 
 @dataclass
@@ -1073,6 +1175,23 @@ def gc_snapshot(
                 except Exception as e:
                     report.errors.append(f"{p}: {e}")
             report.reclaimed = done
+            if fsck.state == "committed" and fsck.cas_refs:
+                # Prune ref-record entries the committed manifest does
+                # not reference (a superseded retake's strands): they
+                # pin shared-store blobs nothing will ever read. The
+                # manifest is immutable, so this is as safe as the
+                # orphan deletes above.
+                from .cas import prune_refs
+
+                pruned = prune_refs(
+                    storage,
+                    event_loop,
+                    _referenced_locations(fsck.metadata),
+                )
+                if pruned:
+                    logger.info(
+                        "gc %s: pruned %d stale CAS ref(s)", path, pruned
+                    )
             return report
         finally:
             storage.sync_close(event_loop)

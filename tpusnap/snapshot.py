@@ -1277,6 +1277,14 @@ def _take_impl(
                     path,
                     len(salvage_records),
                 )
+    # The CAS layer (if composed) was built before the take knew its
+    # rank: per-rank ref records need it (rank 0's file must not be
+    # clobbered by rank 3's flush).
+    from .cas import find_cas_plugin
+
+    cas_layer = find_cas_plugin(storage)
+    if cas_layer is not None:
+        cas_layer.rank = rank
     storage = JournalingStoragePlugin(storage, rank, salvage_records)
     storage.clear_world_size = journal_clear_ws
     if journal_enabled:

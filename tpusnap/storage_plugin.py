@@ -265,6 +265,16 @@ def url_to_storage_plugin(
         from .tiering import build_tiered_plugin
 
         return build_tiered_plugin(url_path, storage_options)
+
+    # Content-addressed composition, explicit form: ``cas+<base>://``
+    # wraps the base (with its ordinary middleware) in the CAS layer;
+    # the shared store comes from storage_options['cas_dir'] /
+    # TPUSNAP_CAS_DIR. Checked before lowercase (the path may embed a
+    # case-sensitive directory name).
+    if scheme.lower().startswith("cas+"):
+        from .cas import build_cas_plugin
+
+        return build_cas_plugin(url_path, storage_options)
     scheme = scheme.lower()
 
     chaos = scheme.startswith(_CHAOS_PREFIX)
@@ -315,6 +325,29 @@ def url_to_storage_plugin(
         plugin = RetryingStoragePlugin(
             plugin, RetryPolicy.from_storage_options(storage_options)
         )
+
+    # Auto-compose the content-addressed layer when TPUSNAP_CAS_DIR is
+    # set: fs-family snapshots transparently publish payload blobs to
+    # the shared store and keep refs instead of private copies. Only
+    # fs-family schemes (CAS ref records + the store's mark phase need
+    # a listable, local snapshot dir); internal plugin builds (the
+    # store's own plugin, fsck/gc probes, the tiering drain's local
+    # re-root) opt out with storage_options={'cas': False} — without
+    # that guard the store plugin would CAS-compose around itself
+    # forever.
+    if (storage_options or {}).get("cas", True) and scheme in ("fs", "file"):
+        from .cas import CASStoragePlugin, resolve_store_url
+
+        store_url = resolve_store_url(None, storage_options)
+        if store_url:
+            plugin = CASStoragePlugin(
+                plugin,
+                base_url=f"fs://{path}",
+                store_url=store_url,
+                storage_options=dict(
+                    storage_options or {}, cas=False
+                ),
+            )
     return plugin
 
 

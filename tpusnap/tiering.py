@@ -595,6 +595,13 @@ class DrainReport:
     lag_bytes: int = 0
     degraded_episodes: int = 0
     error: str = ""
+    # Content-addressed refs (tpusnap.cas): blobs this snapshot holds
+    # as shared-store refs drain at STORE level — each unique blob
+    # uploads once store-wide (store journal keyed by hash), to the
+    # STORE's remote, never as per-snapshot private copies.
+    cas_refs: int = 0
+    cas_blobs_uploaded: int = 0
+    cas_blobs_skipped: int = 0
     bases: List["DrainReport"] = field(default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
@@ -609,6 +616,12 @@ class DrainReport:
             f"({self.bytes_uploaded} bytes), {self.blobs_skipped} skipped "
             f"via journal evidence ({self.bytes_skipped} bytes)"
         )
+        if self.cas_refs:
+            s += (
+                f"; {self.cas_refs} CAS ref(s) drained store-level "
+                f"({self.cas_blobs_uploaded} blob(s) uploaded, "
+                f"{self.cas_blobs_skipped} already proven remote)"
+            )
         if self.lag_bytes:
             s += f"; {self.lag_bytes} bytes still local-only"
         if self.error:
@@ -792,6 +805,12 @@ def _drain_one(
     try:
         local_opts = dict(storage_options or {})
         local_opts.pop("fault_plan", None)
+        # The drain reads the RAW local dir: a CAS-composed view would
+        # synthesize ref'd locations into the listing and resolve their
+        # reads through the store — the drain would then upload shared
+        # blobs as per-snapshot private copies, the exact N× the store
+        # exists to kill. Refs drain at store level below instead.
+        local_opts["cas"] = False
         local = url_to_storage_plugin(local_dir, local_opts or None)
 
         # 1. Local metadata: without a local commit there is nothing to
@@ -861,13 +880,28 @@ def _drain_one(
 
         referenced = _referenced_locations(metadata)
         pending = sorted(p for p in referenced if p in files)
+        # Content-addressed refs: locations this snapshot holds as
+        # shared-store refs have no local file by design — they are
+        # neither "pending" (the store drains them, below) nor
+        # "unreachable" (the ref record IS their reachability).
+        from .cas import read_refs as _read_cas_refs
+        from .cas import resolve_store_url as _resolve_cas_store
+
+        cas_ref_map, cas_store_url = _read_cas_refs(local, event_loop)
+        cas_store_url = cas_store_url or _resolve_cas_store()
+        ref_locs = {
+            p for p in referenced if p in cas_ref_map and p not in files
+        }
+        report.cas_refs = len(ref_locs)
         # Referenced blobs neither present locally NOR carried in the
         # evidence map cannot reach the remote: refusing the durable
         # marker beats blessing a snapshot the remote cannot restore.
         # (Absent-but-evidenced = evicted past a previous durable
         # marker: the remote already holds them.)
         unreachable = sorted(
-            p for p in referenced if p not in files and p not in evidence
+            p
+            for p in referenced
+            if p not in files and p not in evidence and p not in ref_locs
         )
         if unreachable:
             report.state = "missing-blobs"
@@ -992,6 +1026,58 @@ def _drain_one(
                 lag_bytes=_pending_bytes(files, pending, evidence),
                 degraded=False,
             )
+
+        # 4b. CAS refs drain at STORE level: each unique blob uploads
+        # once store-wide to the STORE's remote, with the store journal
+        # (keyed by hash) as the skip evidence — N branched snapshots
+        # referencing one base pay one upload, not N. The durable
+        # marker below requires store-journal proof for EVERY ref'd
+        # key: this snapshot's own journal proves nothing about shared
+        # blobs.
+        if ref_locs:
+            from .cas import blob_key as _cas_key
+            from .cas import drain_store, store_remote_evidence
+            from .io_types import CAS_REFS_DIR
+
+            keys = {_cas_key(tuple(cas_ref_map[p])) for p in ref_locs}
+            if not cas_store_url:
+                report.state = "missing-blobs"
+                report.error = (
+                    f"{len(ref_locs)} CAS ref(s) but no store is "
+                    "configured (TPUSNAP_CAS_DIR unset and no ref "
+                    "record names one) — refusing the durable marker"
+                )
+                return report
+            store_report = drain_store(
+                cas_store_url, keys=keys, storage_options=storage_options
+            )
+            report.cas_blobs_uploaded = store_report.uploaded
+            report.cas_blobs_skipped = store_report.skipped
+            proven, _ = store_remote_evidence(cas_store_url, keys)
+            unproven = sorted(keys - proven)
+            if unproven:
+                report.state = (
+                    "missing-blobs"
+                    if store_report.state == "no-remote"
+                    else "degraded"
+                )
+                report.error = (
+                    f"store drain left {len(unproven)} ref'd blob(s) "
+                    f"unproven remote ({store_report.summary()}) — "
+                    "refusing the durable marker"
+                )
+                return report
+            # Ref records ride to the remote dir before the metadata:
+            # a restore from the bare remote can then resolve every
+            # ref against the store's remote mirror.
+            for p in sorted(files):
+                if not p.startswith(CAS_REFS_DIR + "/") or ".tmp." in p:
+                    continue
+                ref_io = ReadIO(path=p)
+                local.sync_read(ref_io, event_loop)
+                remote.sync_write_atomic(
+                    WriteIO(path=p, buf=ref_io.buf.getvalue()), event_loop
+                )
 
         # 5. Remote metadata LAST (the remote tier becomes a committed
         # snapshot only now), then verify by read-back before the
