@@ -74,6 +74,11 @@
 #      and/or `minio` binary is on PATH, run the `cloud_real` pytest
 #      marker against the real server processes (skipped silently
 #      when the binaries are absent)
+#  15. tune smoke — `tpusnap tune` exit contract: 3 against an empty
+#      history (insufficient comparable events), 0 with a plan against
+#      a seeded history; then a TPUSNAP_AUTOTUNE=1 restore must stamp
+#      the applied plan (`tuned: {plan_id, knobs}`) into its history
+#      event; hermetic like the other smokes
 #
 # Usage:
 #   scripts/ci_gate.sh [SNAPSHOT_PATH]
@@ -92,14 +97,14 @@ cd "$(dirname "$0")/.."
 fail() { echo "ci_gate: FAIL — $1" >&2; exit "$2"; }
 
 # ---- 1. static analysis --------------------------------------------------
-echo "ci_gate: [1/14] lint --check (AST invariants)"
+echo "ci_gate: [1/15] lint --check (AST invariants)"
 env JAX_PLATFORMS=cpu python -m tpusnap lint --check
 rc=$?
 [ "$rc" -eq 0 ] || fail "tpusnap lint --check (rc=$rc)" "$rc"
 
 # ---- 2. tier-1 -----------------------------------------------------------
 if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
-    echo "ci_gate: [2/14] tier-1 tests"
+    echo "ci_gate: [2/15] tier-1 tests"
     rm -f /tmp/_t1.log
     # cloud_real excluded here: on a host with the server binaries the
     # real-backend suite belongs to step 8, not inside the fast tier.
@@ -110,11 +115,11 @@ if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
     echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
     [ "$rc" -eq 0 ] || fail "tier-1 tests (rc=$rc)" "$rc"
 else
-    echo "ci_gate: [2/14] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
+    echo "ci_gate: [2/15] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
 fi
 
 # ---- 3. cross-run history gate ------------------------------------------
-echo "ci_gate: [3/14] history --check (throughput + p99 write latency)"
+echo "ci_gate: [3/15] history --check (throughput + p99 write latency)"
 for kind in take bench; do
     python -m tpusnap history --check --kind "$kind" \
         --metric throughput_gbps --metric storage_write_p99_s --json
@@ -129,8 +134,8 @@ done
 # ---- 4. analyze doctor on the latest snapshot ---------------------------
 SNAP="${1:-${TPUSNAP_CI_SNAPSHOT:-}}"
 if [ -n "$SNAP" ]; then
-    echo "ci_gate: [4/14] analyze --check $SNAP"
-    python -m tpusnap analyze --check --history "$SNAP"
+    echo "ci_gate: [4/15] analyze --check $SNAP"
+    python -m tpusnap analyze --check --history --min-read-roofline 0.4 "$SNAP"
     rc=$?
     case "$rc" in
         0) echo "ci_gate: analyze OK" ;;
@@ -138,11 +143,11 @@ if [ -n "$SNAP" ]; then
         *) fail "analyze --check $SNAP (rc=$rc)" "$rc" ;;
     esac
 else
-    echo "ci_gate: [4/14] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
+    echo "ci_gate: [4/15] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
 fi
 
 # ---- 5. checkpoint-SLO gate smoke ---------------------------------------
-echo "ci_gate: [5/14] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
+echo "ci_gate: [5/15] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, subprocess, sys, tempfile, time
 
@@ -199,7 +204,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "slo --check smoke (rc=$rc)" "$rc"
 
 # ---- 6. delta soak smoke -------------------------------------------------
-echo "ci_gate: [6/14] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
+echo "ci_gate: [6/15] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, re, shutil, signal, subprocess, sys, tempfile, time
 
@@ -343,7 +348,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "delta soak smoke (rc=$rc)" "$rc"
 
 # ---- 7. flight-recorder timeline smoke ----------------------------------
-echo "ci_gate: [7/14] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
+echo "ci_gate: [7/15] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, signal, subprocess, sys, tempfile
 
@@ -417,7 +422,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "timeline smoke (rc=$rc)" "$rc"
 
 # ---- 8. write-back tiering smoke ----------------------------------------
-echo "ci_gate: [8/14] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
+echo "ci_gate: [8/15] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, signal, subprocess, sys, tempfile
 
@@ -507,7 +512,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "tiering smoke (rc=$rc)" "$rc"
 
 # ---- 9. fused-compression smoke ------------------------------------------
-echo "ci_gate: [9/14] compression smoke (compressed take -> fsck/scrub clean -> bit-exact restore; auto bypasses locally, compresses on a throttled pipe)"
+echo "ci_gate: [9/15] compression smoke (compressed take -> fsck/scrub clean -> bit-exact restore; auto bypasses locally, compresses on a throttled pipe)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, sys, tempfile
 
@@ -618,7 +623,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "compression smoke (rc=$rc)" "$rc"
 
 # ---- 10. rank-failure smoke ----------------------------------------------
-echo "ci_gate: [10/14] rank-failure smoke (chaos rank-kill -> fast RankFailedError; degrade-mode replicated take -> committed + scrub clean)"
+echo "ci_gate: [10/15] rank-failure smoke (chaos rank-kill -> fast RankFailedError; degrade-mode replicated take -> committed + scrub clean)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import atexit, os, re, shutil, subprocess, sys, tempfile
 
@@ -764,7 +769,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "rank-failure smoke (rc=$rc)" "$rc"
 
 # ---- 11. elastic-stream smoke ---------------------------------------------
-echo "ci_gate: [11/14] elastic-stream smoke (2-process stream survives a SIGKILLed rank via a degraded epoch; graceful leave + re-join re-plan the world)"
+echo "ci_gate: [11/15] elastic-stream smoke (2-process stream survives a SIGKILLed rank via a degraded epoch; graceful leave + re-join re-plan the world)"
 env JAX_PLATFORMS=cpu TPUSNAP_HISTORY=0 python -m pytest -q \
     tests/test_stream_elastic.py::test_stream_survives_rank_sigkill \
     tests/test_stream_elastic.py::test_stream_graceful_leave_and_rejoin \
@@ -773,7 +778,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "elastic-stream smoke (rc=$rc)" "$rc"
 
 # ---- 12. fleet observability smoke ----------------------------------------
-echo "ci_gate: [12/14] mini-fleetsim smoke (3 jobs, rank-kill + outage faults; fleet --check exit contract: 0 healthy / 2 breach / 3 no records)"
+echo "ci_gate: [12/15] mini-fleetsim smoke (3 jobs, rank-kill + outage faults; fleet --check exit contract: 0 healthy / 2 breach / 3 no records)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import atexit, json, os, shutil, signal, subprocess, sys, tempfile, time
 
@@ -878,7 +883,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "mini-fleetsim smoke (rc=$rc)" "$rc"
 
 # ---- 13. content-addressed store smoke ------------------------------------
-echo "ci_gate: [13/14] CAS smoke (two jobs share a base through one store; SIGKILL mid-gc-sweep -> re-run gc converges -> fsck --store exit 0)"
+echo "ci_gate: [13/15] CAS smoke (two jobs share a base through one store; SIGKILL mid-gc-sweep -> re-run gc converges -> fsck --store exit 0)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import atexit, os, shutil, signal, subprocess, sys, tempfile, time
 
@@ -973,7 +978,7 @@ rc=$?
 
 # ---- 14. optional real-backend cloud suite -------------------------------
 if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&1; then
-    echo "ci_gate: [14/14] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
+    echo "ci_gate: [14/15] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m cloud_real \
         -p no:cacheprovider -p no:xdist -p no:randomly
     rc=$?
@@ -983,7 +988,91 @@ if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&
         fail "real-backend cloud suite (rc=$rc)" "$rc"
     fi
 else
-    echo "ci_gate: [14/14] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
+    echo "ci_gate: [14/15] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
 fi
+
+# ---- 15. tune smoke ------------------------------------------------------
+echo "ci_gate: [15/15] tune smoke (exit contract: 0 plan / 3 insufficient history; TPUSNAP_AUTOTUNE=1 restore stamps the applied plan)"
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, shutil, subprocess, sys, tempfile
+
+work = tempfile.mkdtemp(prefix="tpusnap_ci_tune_")
+tele = os.path.join(work, "tele")
+# Hermetic: history lives in the tempdir, never the host's.
+env = dict(os.environ, JAX_PLATFORMS="cpu", TPUSNAP_TELEMETRY_DIR=tele)
+import atexit
+atexit.register(shutil.rmtree, work, True)
+
+def tune(*extra, e=None):
+    return subprocess.run(
+        [sys.executable, "-m", "tpusnap", "tune", "--check", *extra],
+        capture_output=True, text=True, env=e or env, timeout=120,
+    )
+
+def die(msg):
+    print(f"tune smoke: FAIL - {msg}", file=sys.stderr)
+    sys.exit(1)
+
+# (a) empty history -> insufficient comparable events -> exit 3
+r = tune(e=dict(env, TPUSNAP_TELEMETRY_DIR=os.path.join(work, "empty")))
+if r.returncode != 3:
+    die(f"empty history: expected exit 3, got {r.returncode}: "
+        f"{r.stdout[-300:]}{r.stderr[-300:]}")
+
+# (b) one real take+restore seeds a genuine restore event (correct
+# plugin label), then clones of it give the cell enough evidence; the
+# 1 GiB payload makes the probe-cadence rule fire deterministically
+# against the 2 GiB default interval.
+script = (
+    "import os; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+    "import numpy as np, sys\n"
+    "from tpusnap import Snapshot, StateDict\n"
+    "s = {'a': StateDict(w=np.arange(200000, dtype=np.float32))}\n"
+    "Snapshot.take(sys.argv[1], s)\n"
+    "t = {'a': StateDict(w=np.zeros(200000, dtype=np.float32))}\n"
+    "Snapshot(sys.argv[1]).restore(t)\n"
+)
+snap = os.path.join(work, "snap")
+subprocess.run([sys.executable, "-c", script, snap],
+               check=True, env=env, timeout=180)
+hist = os.path.join(tele, "history.jsonl")
+events = [json.loads(ln) for ln in open(hist) if ln.strip()]
+base = next(e for e in reversed(events) if e.get("kind") == "restore")
+with open(hist, "a") as f:
+    for _ in range(3):
+        seed = dict(base, bytes=1 << 30, wall_s=2.0)
+        f.write(json.dumps(seed) + "\n")
+r = tune("--kind", "restore")
+if r.returncode != 0:
+    die(f"seeded history: expected exit 0, got {r.returncode}: "
+        f"{r.stdout[-400:]}{r.stderr[-300:]}")
+r = tune("--kind", "restore", "--json")
+plan = json.loads(r.stdout)
+if not plan.get("ok") or not plan.get("plan_id") or not plan.get("knobs"):
+    die(f"seeded plan must carry plan_id + knobs: {r.stdout[-400:]}")
+
+# (c) TPUSNAP_AUTOTUNE=1 restore applies the plan and stamps
+# `tuned: {plan_id, knobs}` into its history event.
+restore = (
+    "import os; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+    "import numpy as np, sys\n"
+    "from tpusnap import Snapshot, StateDict\n"
+    "t = {'a': StateDict(w=np.zeros(200000, dtype=np.float32))}\n"
+    "Snapshot(sys.argv[1]).restore(t)\n"
+)
+subprocess.run([sys.executable, "-c", restore, snap], check=True,
+               env=dict(env, TPUSNAP_AUTOTUNE="1"), timeout=180)
+events = [json.loads(ln) for ln in open(hist) if ln.strip()]
+last = next(e for e in reversed(events) if e.get("kind") == "restore")
+tuned = last.get("tuned")
+if not isinstance(tuned, dict) or not tuned.get("plan_id") or not tuned.get("knobs"):
+    die(f"autotuned restore event must stamp tuned: {json.dumps(last)[:400]}")
+if tuned["plan_id"] != plan["plan_id"]:
+    die(f"applied plan_id {tuned['plan_id']} != planned {plan['plan_id']}")
+print("tune smoke: OK (exit 3 empty, exit 0 seeded, autotune stamped "
+      f"plan {tuned['plan_id']})")
+PYEOF
+rc=$?
+[ "$rc" -eq 0 ] || fail "tune smoke (rc=$rc)" "$rc"
 
 echo "ci_gate: PASS"

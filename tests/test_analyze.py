@@ -26,7 +26,12 @@ from tpusnap.analyze import (
     straggler_findings,
     tail_latency_findings,
 )
-from tpusnap.knobs import override_probe, override_telemetry_enabled
+from tpusnap.knobs import (
+    override_probe,
+    override_telemetry_dir,
+    override_telemetry_enabled,
+)
+from tpusnap.progress import load_restore_traces
 from tpusnap.telemetry import IOStats, LogHistogram
 
 
@@ -402,6 +407,116 @@ def test_stranded_probe_file_does_not_make_aborted_dir_foreign(tmp_path):
     assert report.state == "empty", (report.state, report.detail)
 
 
+# ------------------------------------------- probe runner: read path
+
+
+def _probe_restore(tmp_path, total_bytes=64 << 20, n=8):
+    """Take, then restore with in-restore probes on. Returns the
+    restore summary and rank 0's persisted restore trace doc."""
+    from tpusnap import compress
+
+    snap = str(tmp_path / "snap")
+    state = _state(total_bytes=total_bytes, n=n)
+    Snapshot.take(snap, {"m": PytreeState(state)})
+    compress._reset_ceilings()
+    with override_telemetry_dir(str(tmp_path / "teledir")):
+        with override_probe(
+            True, interval_bytes=16 << 20, probe_bytes=1 << 20
+        ):
+            Snapshot(snap).restore(
+                {
+                    "m": PytreeState(
+                        {k: np.zeros_like(v) for k, v in state.items()}
+                    )
+                }
+            )
+        docs = load_restore_traces(snap)
+    return snap, telemetry.LAST_RESTORE_SUMMARY, docs[0]
+
+
+def test_restore_probe_feeds_read_lane_and_history(tmp_path):
+    """In-restore probes (TPUSNAP_PROBE=1): the restore summary gets
+    the read-lane fraction, the ceiling registry gets a read-lane
+    entry, no probe scratch survives, and the history event carries
+    the drift-immune read fields."""
+    from tpusnap import compress
+    from tpusnap.history import event_from_summary
+
+    snap, s, _doc = _probe_restore(tmp_path)
+    assert s["probe"]["probes"] >= 1
+    assert s["probe"]["read_gbps_p50"] > 0
+    assert 0 < s["restore_roofline_fraction"]
+    # The write-lane fraction belongs to takes — a restore summary
+    # must not grow one.
+    assert "roofline_fraction" not in s
+    lanes = {lane for (_label, lane) in compress.pipe_ceilings_snapshot()}
+    assert "read" in lanes
+    assert not glob.glob(os.path.join(snap, ".tpusnap", "probe", "*"))
+    ev = event_from_summary("restore", s)
+    assert ev["restore_roofline_fraction"] == s["restore_roofline_fraction"]
+    assert ev["probe_read_gbps"] == s["probe"]["read_gbps_p50"]
+    assert "roofline_fraction" not in ev
+
+
+def test_restore_probe_spans_outside_read_windows(tmp_path):
+    """Probes only run while no blob read is in flight — a probe
+    interleaved with reads would bill its own I/O to the storage_read
+    window it exists to price. No probe span may overlap any
+    storage_read span in the restore trace."""
+    _snap, s, doc = _probe_restore(tmp_path)
+    assert s["probe"]["probes"] >= 1
+    spans = {"probe_roofline": [], "storage_read": []}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "X" and ev.get("name") in spans:
+            spans[ev["name"]].append((ev["ts"], ev["ts"] + ev["dur"]))
+    assert spans["probe_roofline"] and spans["storage_read"]
+    for p0, p1 in spans["probe_roofline"]:
+        for r0, r1 in spans["storage_read"]:
+            assert p1 <= r0 or r1 <= p0, (
+                "probe span overlaps a read window",
+                (p0, p1),
+                (r0, r1),
+            )
+
+
+def test_restore_probe_stands_down_on_read_lane():
+    """The stand-down contract holds on the restore side too: one
+    failed probe disables probing for the restore, and the summary
+    grows neither a probe block nor restore_roofline_fraction."""
+    import asyncio
+
+    from tpusnap.io_types import StoragePlugin
+    from tpusnap.scheduler import _ProbeRunner
+
+    class BoomPlugin(StoragePlugin):
+        async def write(self, write_io):
+            raise OSError("probe scratch rejected")
+
+        async def read(self, read_io):
+            raise OSError("nope")
+
+        async def delete(self, path):
+            pass
+
+    with override_probe(True, interval_bytes=1 << 20, probe_bytes=1 << 20):
+        tele = telemetry.TakeTelemetry(rank=0, enabled=True)
+        tele.meta["kind"] = "restore"
+        try:
+            runner = _ProbeRunner(BoomPlugin(), rank=0, tele=tele)
+            runner.note_written(1 << 30)
+            assert runner.due
+            asyncio.run(runner.run())
+        finally:
+            tele.finalize()
+    assert runner.ran == 0
+    assert runner._failed
+    runner.note_written(1 << 30)
+    assert not runner.due  # stood down for the rest of this restore
+    s = tele.summary()
+    assert "probe" not in s
+    assert "restore_roofline_fraction" not in s
+
+
 def test_quantile_geometric_interpolation_stays_in_bucket():
     # The interpolated estimate never leaves the bucket that holds the
     # target rank, and clamps to the exact observed extremes.
@@ -622,4 +737,48 @@ def test_distributed_histogram_merge_in_rollup(tmp_path):
 
     run_subprocess_world(
         _world_histogram_take, world_size=2, args=[str(tmp_path / "snap")]
+    )
+
+
+def _world_probe_restore(snap_dir):
+    import numpy as np
+
+    from tpusnap import PytreeState, Snapshot, telemetry
+    from tpusnap.comm import get_communicator
+    from tpusnap.knobs import override_probe
+    from tpusnap.progress import load_restore_traces
+    from tpusnap.telemetry import rollup_summaries
+
+    comm = get_communicator()
+    state = {"w": np.arange(1 << 21, dtype=np.uint8) + comm.rank}
+    Snapshot.take(snap_dir, {"m": PytreeState(state)})
+    comm.barrier()
+    with override_probe(True, interval_bytes=1 << 20, probe_bytes=1 << 20):
+        Snapshot(snap_dir).restore(
+            {"m": PytreeState({"w": np.zeros(1 << 21, np.uint8)})}
+        )
+    s = telemetry.LAST_RESTORE_SUMMARY
+    assert s.get("restore_roofline_fraction"), sorted(s)
+    comm.barrier()
+    if comm.rank == 0:
+        # Every rank persisted a restore trace; the cross-rank fold
+        # carries the read-lane fraction (fleet p50) and the probe
+        # aggregate — what `analyze --restore` and the Prometheus
+        # gauge read.
+        docs = load_restore_traces(snap_dir)
+        assert sorted(docs) == [0, 1], sorted(docs)
+        roll = rollup_summaries([d["summary"] for d in docs.values()])
+        assert roll["restore_roofline_fraction"] > 0
+        assert roll["probe"]["read_gbps_p50"] > 0
+
+
+@pytest.mark.distributed
+def test_distributed_restore_rollup_carries_read_fraction(tmp_path):
+    from tpusnap.test_utils import run_subprocess_world
+
+    run_subprocess_world(
+        _world_probe_restore,
+        world_size=2,
+        args=[str(tmp_path / "snap")],
+        extra_env={"TPUSNAP_TELEMETRY_DIR": str(tmp_path / "teledir")},
     )

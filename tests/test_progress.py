@@ -445,6 +445,47 @@ def test_restore_persists_trace_and_cli(tmp_path, capsys):
         assert doc["rollup"]["phase_coverage_min"] >= 0.9
 
 
+def test_back_to_back_restores_keep_run_scoped_traces(tmp_path):
+    """Back-to-back restores of the same snapshot must NOT clobber each
+    other's traces: each run writes its own rank_<k>.<run>.json, the
+    rank_<k>.json latest-pointer tracks the newest, and retention is
+    bounded per digest+rank."""
+    from tpusnap.progress import RESTORE_TRACE_KEEP, load_restore_traces
+
+    path = str(tmp_path / "snap")
+    state = {"w": np.arange(4096, dtype=np.float32)}
+    Snapshot.take(path, {"m": PytreeState(state)})
+    with override_telemetry_dir(str(tmp_path / "teledir")):
+        n_runs = RESTORE_TRACE_KEEP + 2
+        for _ in range(n_runs):
+            Snapshot(path).restore(
+                {"m": PytreeState({"w": np.zeros(4096, np.float32)})}
+            )
+        tdir = restore_trace_dir(path)
+        runs = [
+            n
+            for n in os.listdir(tdir)
+            if n.startswith("rank_0.") and n != "rank_0.json"
+        ]
+        # Every run got its own file, bounded by the retention cap.
+        assert len(runs) == RESTORE_TRACE_KEEP, sorted(runs)
+        # The latest pointer resolves to one of the retained run files
+        # and still reads as a full trace doc (what `trace --restore`
+        # and `analyze --restore` load).
+        latest = os.path.join(tdir, "rank_0.json")
+        assert os.path.islink(latest)
+        assert os.readlink(latest) in runs
+        docs = load_restore_traces(path)
+        assert sorted(docs) == [0]
+        assert docs[0]["kind"] == "restore"
+        assert docs[0]["run_id"] in os.readlink(latest)
+        # Retained run files are distinct documents, not copies.
+        run_ids = set()
+        for n in runs:
+            run_ids.add(json.load(open(os.path.join(tdir, n)))["run_id"])
+        assert len(run_ids) == len(runs)
+
+
 def test_trace_restore_without_traces_exits_3(tmp_path, capsys):
     from tpusnap.__main__ import main
 

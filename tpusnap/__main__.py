@@ -81,7 +81,24 @@ counterpart — torchsnapshot ships no CLI and no integrity checking):
                         ``--history`` adds trend context; ``--json``
                         for machines; ``--check`` exits 2 when any
                         warn-severity finding fires (exit 3 = no
-                        telemetry recorded, matching ``trace``)
+                        telemetry recorded, matching ``trace``); the
+                        restore view also attributes the decode lane
+                        and reports ``restore_roofline_fraction``
+                        against the in-restore probe READ ceiling
+                        (``--min-read-roofline`` gates it)
+
+  tune                  deterministic knob planner for one (backend,
+                        kind, world_size) cell: history.jsonl events +
+                        probe ceilings (+ ``--snapshot``'s analyze
+                        verdict) in, one proposed env value per knob
+                        out, each with a one-line rationale (table /
+                        ``--json`` / ``--env`` shell exports;
+                        ``--check`` exits 0 with a plan, 3 on
+                        insufficient history; TPUSNAP_AUTOTUNE=1
+                        applies the plan at take/restore begin —
+                        explicit env vars always win, and applied
+                        knobs are stamped into the history event as
+                        ``tuned``)
 
   timeline    PATH      forensic cross-rank timeline from the flight-
                         recorder sidecars (.tpusnap/flight/rank_<k>.jsonl,
@@ -146,7 +163,8 @@ Exit codes: 0 success / clean, 1 usage or read error, 2 corruption found
 fleet objective breach), 3 undecidable/unverifiable (or no telemetry
 recorded — trace and analyze; no flight data — timeline; fsck:
 empty/foreign; history: no/insufficient events; slo: no records / no
-estimator verdict; fleet: no status records), 4 torn
+estimator verdict; fleet: no status records; tune: insufficient
+comparable history), 4 torn
 take (fsck — salvageable by retaking the path; timeline: uncommitted
 path, post-mortem verdict printed).
 """
@@ -440,10 +458,11 @@ def cmd_info(args) -> int:
 
         est = estimate_rto(rank_payload_nbytes(md, 0), backend=restore_backend)
         if est.ok:
+            src = getattr(est, "source", "history")
             print(
                 f"est restore: {_fmt_seconds(est.seconds)} "
                 f"({est.reason}"
-                + (f", {restore_backend} history" if restore_backend else "")
+                + (f", {restore_backend} {src}" if restore_backend else "")
                 + "; `slo` for live exposure)"
             )
     except Exception:
@@ -1020,6 +1039,18 @@ def _render_analyze(path: str, report: dict) -> None:
                 f"{probe.get('probes', 0)} probe(s))"
             )
         print(line)
+    if report.get("restore_roofline_fraction") is not None:
+        line = (
+            f"\nread roofline: {report['restore_roofline_fraction']:.1%} "
+            "of the in-restore probe READ ceiling"
+        )
+        probe = report.get("probe") or {}
+        if probe.get("read_gbps_p50"):
+            line += (
+                f" ({probe['read_gbps_p50']:.2f} GB/s over "
+                f"{probe.get('probes', 0)} probe(s))"
+            )
+        print(line)
     trend = report.get("history")
     if trend and trend.get("events"):
         print(f"\nhistory trend (last {trend['events']} {kind} event(s)):")
@@ -1048,6 +1079,7 @@ def cmd_analyze(args) -> int:
     thresholds = Thresholds(
         p99_ratio=args.p99_ratio,
         min_roofline=args.min_roofline,
+        min_read_roofline=args.min_read_roofline,
         max_skew=args.max_skew,
     )
     history_events = None
@@ -1132,6 +1164,101 @@ def cmd_analyze(args) -> int:
         _render_analyze(args.path, report)
     if args.check and report.get("check_failed"):
         return 2
+    return 0
+
+
+def cmd_tune(args) -> int:
+    import json as _json
+
+    from . import compress
+    from .history import history_path, load_history
+    from .tune import build_plan
+
+    path = args.file or history_path()
+    events = load_history(path)
+    kind = args.kind
+    if kind is None:
+        # Default cell: whatever this host did last.
+        kind = next(
+            (
+                e.get("kind")
+                for e in reversed(events)
+                if e.get("kind") in ("take", "restore")
+            ),
+            "take",
+        )
+    # Best-effort bound verdict from persisted traces (--snapshot):
+    # absence degrades the plan (verdict-driven rules skip), never
+    # fails it.
+    verdict = None
+    if args.snapshot:
+        try:
+            from .analyze import analyze
+            from .telemetry import rollup_summaries
+
+            if kind == "restore":
+                from .progress import load_restore_traces
+
+                docs = load_restore_traces(args.snapshot)
+            else:
+                _w, _roll, docs = _load_take_traces(args.snapshot)
+            if docs:
+                roll = rollup_summaries(
+                    [d.get("summary") or {} for d in docs.values()]
+                )
+                verdict = analyze(roll, docs, kind=kind).get("bound_by")
+        except Exception:
+            verdict = None
+    plan = build_plan(
+        events,
+        kind,
+        backend=args.backend,
+        world_size=args.world_size,
+        ceilings=compress.pipe_ceilings_snapshot(),
+        verdict=verdict,
+        window=args.window,
+    )
+    if args.json:
+        print(_json.dumps({"history": path, **plan.to_json()}))
+    elif args.env:
+        if plan.ok:
+            print(f"# tune plan {plan.plan_id}: {plan.reason}")
+            for line in plan.env_exports():
+                print(line)
+        else:
+            print(f"# no plan: {plan.reason}")
+    else:
+        cell = (
+            f"backend={plan.backend or 'any'} kind={plan.kind} "
+            f"world_size={plan.world_size or 'any'}"
+        )
+        if not plan.ok:
+            print(f"cell:    {cell}")
+            print(f"no plan: {plan.reason}")
+        else:
+            print(f"plan:    {plan.plan_id}")
+            print(f"cell:    {cell}")
+            print(
+                f"evidence: {plan.n_events} event(s)"
+                + (f", bound verdict {plan.verdict!r}" if plan.verdict else "")
+            )
+            if not plan.knobs:
+                print(f"\n{plan.reason}")
+            else:
+                print(f"\n{'knob':<42s} {'current':>14s} {'planned':>14s}")
+                for k in plan.knobs:
+                    print(
+                        f"{k.env:<42s} {(k.current or '(default)'):>14s} "
+                        f"{k.value:>14s}"
+                    )
+                    print(f"    {k.rationale}")
+                print(
+                    "\napply: eval \"$(python -m tpusnap tune --env)\" — or "
+                    "set TPUSNAP_AUTOTUNE=1 to reconcile at take/restore "
+                    "begin (explicit env vars always win)"
+                )
+    if not plan.ok:
+        return 3
     return 0
 
 
@@ -1715,6 +1842,9 @@ def cmd_slo(args) -> int:
                     if on
                 ]
                 rto = r.get("estimated_rto_s")
+                rto_cell = _fmt_seconds(rto) if rto is not None else "-"
+                if rto is not None and r.get("rto_source") == "probe":
+                    rto_cell += "~"
                 since = (
                     _fmt_age(r["since_commit_s"])
                     if r.get("committed")
@@ -1725,7 +1855,7 @@ def cmd_slo(args) -> int:
                 print(
                     f"{r['rank']:>4} {since:>13} "
                     f"{_fmt_bytes(r['data_at_risk_bytes']):>10} "
-                    f"{(_fmt_seconds(rto) if rto is not None else '-'):>9} "
+                    f"{rto_cell:>9} "
                     f"{_fmt_age(r['record_age_s']):>8} {dead_s:>6}  "
                     f"{','.join(flags) or '-'}"
                     + ("  (exited cleanly; exposure frozen)"
@@ -1758,6 +1888,11 @@ def cmd_slo(args) -> int:
                 )
             if any(not r.get("committed") for r in report["ranks"]):
                 print("(* = no commit yet; exposure counted from tracker start)")
+            if any(r.get("rto_source") == "probe" for r in report["ranks"]):
+                print(
+                    "(~ = RTO priced from the read-lane probe ceiling — "
+                    "no restore history yet, no overhead term)"
+                )
         if tier:
             if tier_degraded:
                 print(
@@ -2175,12 +2310,67 @@ def main(argv=None) -> int:
         "ceiling (default 0.4; needs TPUSNAP_PROBE=1 at take time)",
     )
     p.add_argument(
+        "--min-read-roofline", type=float, default=0.4, metavar="F",
+        dest="min_read_roofline",
+        help="flag a restore below this fraction of its in-restore "
+        "probe READ ceiling (default mirrors --min-roofline's 0.4; "
+        "needs TPUSNAP_PROBE=1 at restore time)",
+    )
+    p.add_argument(
         "--max-skew", type=float, default=2.0, metavar="S",
         dest="max_skew",
         help="flag a phase whose slowest rank exceeds S x the p50 "
         "(default 2.0)",
     )
     p.set_defaults(fn=cmd_analyze)
+
+    p = sub.add_parser(
+        "tune",
+        help="deterministic knob plan for one (backend, kind, "
+        "world_size) cell from history.jsonl + probe ceilings + the "
+        "analyze verdict (exit 0 plan / 3 insufficient history)",
+    )
+    p.add_argument(
+        "--file", default=None,
+        help="history file (default: TPUSNAP_TELEMETRY_DIR/history.jsonl)",
+    )
+    p.add_argument(
+        "--kind", choices=("take", "restore"), default=None,
+        help="plan cell kind (default: this host's newest event's kind)",
+    )
+    p.add_argument(
+        "--backend", default=None, metavar="LABEL",
+        help="plan cell backend (innermost plugin class label; "
+        "default: the newest matching event's)",
+    )
+    p.add_argument(
+        "--world-size", type=int, default=None, dest="world_size",
+        metavar="N",
+        help="plan cell world size (default: the newest matching "
+        "event's)",
+    )
+    p.add_argument(
+        "--snapshot", default=None, metavar="PATH",
+        help="fold the analyze bound verdict from PATH's persisted "
+        "traces into the plan (best-effort)",
+    )
+    p.add_argument(
+        "--window", type=int, default=50, metavar="N",
+        help="newest N cell events to plan from (default 50)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable plan"
+    )
+    p.add_argument(
+        "--env", action="store_true",
+        help="shell-exportable `export TPUSNAP_X=value` lines",
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="exit 0 when a plan renders, 3 on insufficient "
+        "comparable history — the CI contract",
+    )
+    p.set_defaults(fn=cmd_tune)
 
     p = sub.add_parser(
         "timeline",

@@ -70,6 +70,7 @@ _CATEGORY_EXACT = {
     "cow_verify": "checksum",
     "compress": "compress",
     "consume": "consume",
+    "restore.decode": "decode",
     "budget_wait": "budget_wait",
 }
 _CATEGORY_PREFIX = (
@@ -84,6 +85,10 @@ WORK_PRIORITY = (
     "storage_write",
     "storage_read",
     "dtoh",
+    # decode outranks consume: restore.decode spans nest inside their
+    # containing consume span, and the nested lane must claim the
+    # overlap or decode time vanishes into the generic consume bucket.
+    "decode",
     "consume",
     "stage",
     "compress",
@@ -132,6 +137,14 @@ ADVICE = {
         "restore consume (deserialize + HtoD) dominates — check that "
         "in-place reads are active (they skip the copy-out) and batch "
         "small objects"
+    ),
+    "decode": (
+        "the fused tile DECOMPRESSOR dominates the restore — the pipe "
+        "outruns the codec on the read side, so write the next snapshot "
+        "uncompressed for this tier (TPUSNAP_COMPRESS=off forces it; "
+        "auto mode decides from the write-side ceiling, which can be "
+        "faster than this read pipe); decode threads derive from the "
+        "TPUSNAP_STAGE_THREADS budget if you'd rather keep the codec"
     ),
     "compress": (
         "the fused tile codec dominates — the pipe outruns the codec "
@@ -344,6 +357,7 @@ class Thresholds:
 
     p99_ratio: float = 20.0  # write/read p99 over p50 beyond this → warn
     min_roofline: float = 0.4  # roofline_fraction below this → warn
+    min_read_roofline: float = 0.4  # restore_roofline_fraction gate
     max_skew: float = 2.0  # per-phase straggler skew beyond this → warn
     min_coverage: float = 0.5  # attribution coverage below this → info
 
@@ -413,12 +427,11 @@ def straggler_findings(
 def roofline_findings(
     summary_like: Dict[str, Any], thresholds: Thresholds
 ) -> List[Finding]:
+    out: List[Finding] = []
     frac = (summary_like or {}).get("roofline_fraction")
-    if not isinstance(frac, (int, float)):
-        return []
-    if frac < thresholds.min_roofline:
+    if isinstance(frac, (int, float)) and frac < thresholds.min_roofline:
         ceiling = ((summary_like.get("probe") or {}).get("write_gbps_p50"))
-        return [
+        out.append(
             Finding(
                 "warn",
                 "roofline",
@@ -428,8 +441,27 @@ def roofline_findings(
                 + " — the pipeline, not the disk, is leaving throughput "
                 "on the table; see the bound verdict",
             )
-        ]
-    return []
+        )
+    rfrac = (summary_like or {}).get("restore_roofline_fraction")
+    if (
+        isinstance(rfrac, (int, float))
+        and rfrac < thresholds.min_read_roofline
+    ):
+        ceiling = ((summary_like.get("probe") or {}).get("read_gbps_p50"))
+        out.append(
+            Finding(
+                "warn",
+                "read_roofline",
+                f"restore achieved only {rfrac:.0%} of the in-restore "
+                "probe READ ceiling"
+                + (f" ({ceiling:.2f} GB/s)" if ceiling else "")
+                + " — the restore pipeline, not the disk, is leaving "
+                "read throughput on the table; see the bound verdict "
+                "(decode-bound restores overlap away under a pipelined "
+                "engine)",
+            )
+        )
+    return out
 
 
 # ---------------------------------------------------------- the report
@@ -502,15 +534,20 @@ def analyze(
     findings.extend(straggler_findings(rollup, thresholds))
 
     # Roofline: rollup first (multi-rank p50), else the slowest rank.
+    # Takes carry roofline_fraction (write lane); restores carry
+    # restore_roofline_fraction (read lane) — same source selection.
     roofline_src: Dict[str, Any] = {}
-    if isinstance(rollup.get("roofline_fraction"), (int, float)):
+    _FRACS = ("roofline_fraction", "restore_roofline_fraction")
+    if any(isinstance(rollup.get(f), (int, float)) for f in _FRACS):
         roofline_src = rollup
     elif slowest_rank is not None:
         s = rank_docs[slowest_rank].get("summary") or {}
-        if isinstance(s.get("roofline_fraction"), (int, float)):
+        if any(isinstance(s.get(f), (int, float)) for f in _FRACS):
             roofline_src = s
     if roofline_src:
-        report["roofline_fraction"] = roofline_src["roofline_fraction"]
+        for f in _FRACS:
+            if isinstance(roofline_src.get(f), (int, float)):
+                report[f] = roofline_src[f]
         if roofline_src.get("probe"):
             report["probe"] = roofline_src["probe"]
         findings.extend(roofline_findings(roofline_src, thresholds))
@@ -533,7 +570,13 @@ def history_context(
     out: Dict[str, Any] = {"events": len(cand)}
     if not cand:
         return out
-    for metric in ("throughput_gbps", "storage_write_p99_s", "roofline_fraction"):
+    for metric in (
+        "throughput_gbps",
+        "storage_write_p99_s",
+        "roofline_fraction",
+        "storage_read_p99_s",
+        "restore_roofline_fraction",
+    ):
         vals = sorted(
             float(e[metric])
             for e in cand

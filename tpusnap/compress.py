@@ -28,7 +28,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -89,11 +89,14 @@ def codec_elem(codec: str) -> int:
 
 # ---------------------------------------------------------------- ceilings
 
-# Process-global pipe ceilings by storage label (innermost plugin class
-# name), fed by every in-take roofline probe sample and by the policy
-# mini-probe. Newest sample wins: the probe's whole point is that the
+# Process-global pipe ceilings by (storage label, lane), fed by every
+# roofline probe sample (each probe measures both its write and read
+# legs) and by the policy mini-probe. Lanes are "write" and "read":
+# asymmetric backends (write-back tiers, read-optimized mounts) get
+# separate ceilings so the restore roofline never divides by a write
+# number. Newest sample wins: the probe's whole point is that the
 # ceiling is a live measurement, not a config belief.
-_ceilings: Dict[str, float] = {}
+_ceilings: Dict[Tuple[str, str], float] = {}
 _ceilings_lock = threading.Lock()
 
 
@@ -133,16 +136,23 @@ def pipe_ceiling_key(storage) -> str:
     return label
 
 
-def note_pipe_ceiling(label: str, write_gbps: float) -> None:
-    if not label or write_gbps <= 0:
+def note_pipe_ceiling(label: str, gbps: float, lane: str = "write") -> None:
+    if not label or gbps <= 0:
         return
     with _ceilings_lock:
-        _ceilings[label] = float(write_gbps)
+        _ceilings[(label, lane)] = float(gbps)
 
 
-def pipe_ceiling(label: str) -> Optional[float]:
+def pipe_ceiling(label: str, lane: str = "write") -> Optional[float]:
     with _ceilings_lock:
-        return _ceilings.get(label)
+        return _ceilings.get((label, lane))
+
+
+def pipe_ceilings_snapshot() -> Dict[Tuple[str, str], float]:
+    """Copy of every (label, lane) ceiling known to this process — the
+    tune planner's view of what the probes have measured."""
+    with _ceilings_lock:
+        return dict(_ceilings)
 
 
 def _reset_ceilings() -> None:

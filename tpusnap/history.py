@@ -165,37 +165,53 @@ def event_from_summary(kind: str, summary: Dict[str, Any]) -> Dict[str, Any]:
         ev["compress_bytes_in"] = int(c_in)
         ev["compress_bytes_out"] = int(c_out)
         ev["compress_ratio"] = round(c_in / c_out, 4)
-    # Storage-boundary write-latency quantiles from the take's log2
-    # histograms (merged across plugin classes): *_s metrics, so
-    # `history --check --metric storage_write_p99_s` gates tail latency
-    # upward exactly like every other duration.
-    write_lat = None
-    for key, st in (summary.get("io_histograms") or {}).items():
-        if not key.startswith("write."):
-            continue
-        try:
-            from .telemetry import LogHistogram
+    # Storage-boundary latency quantiles from the run's log2 histograms
+    # (merged across plugin classes, per op): *_s metrics, so `history
+    # --check --metric storage_write_p99_s` (and storage_read_p99_s on
+    # restores/benches) gates tail latency upward exactly like every
+    # other duration.
+    for op in ("write", "read"):
+        op_lat = None
+        for key, st in (summary.get("io_histograms") or {}).items():
+            if not key.startswith(f"{op}."):
+                continue
+            try:
+                from .telemetry import LogHistogram
 
-            h = LogHistogram.from_dict(st.get("latency") or {})
-        except Exception:
-            continue
-        if write_lat is None:
-            write_lat = h
-        else:
-            write_lat.merge(h)
-    if write_lat is not None and write_lat.count:
-        p50, p99 = write_lat.quantile(0.5), write_lat.quantile(0.99)
-        if p50 is not None:
-            ev["storage_write_p50_s"] = round(p50, 6)
-        if p99 is not None:
-            ev["storage_write_p99_s"] = round(p99, 6)
-    # In-take roofline probes (TPUSNAP_PROBE=1): the drift-immune
-    # fraction and the measured ceiling ride the trend.
+                h = LogHistogram.from_dict(st.get("latency") or {})
+            except Exception:
+                continue
+            if op_lat is None:
+                op_lat = h
+            else:
+                op_lat.merge(h)
+        if op_lat is not None and op_lat.count:
+            p50, p99 = op_lat.quantile(0.5), op_lat.quantile(0.99)
+            if p50 is not None:
+                ev[f"storage_{op}_p50_s"] = round(p50, 6)
+            if p99 is not None:
+                ev[f"storage_{op}_p99_s"] = round(p99, 6)
+    # Roofline probes (TPUSNAP_PROBE=1): the drift-immune fraction and
+    # the measured ceiling ride the trend — write lane for takes, read
+    # lane for restores.
     if isinstance(summary.get("roofline_fraction"), (int, float)):
         ev["roofline_fraction"] = round(float(summary["roofline_fraction"]), 4)
         pw = (summary.get("probe") or {}).get("write_gbps_p50")
         if pw:
             ev["probe_write_gbps"] = pw
+    if isinstance(summary.get("restore_roofline_fraction"), (int, float)):
+        ev["restore_roofline_fraction"] = round(
+            float(summary["restore_roofline_fraction"]), 4
+        )
+        pr = (summary.get("probe") or {}).get("read_gbps_p50")
+        if pr:
+            ev["probe_read_gbps"] = pr
+    # Auto-tuner provenance (TPUSNAP_AUTOTUNE=1): which plan and which
+    # knobs this run actually applied, so any regression the tuner
+    # causes is attributable — and gated by the same `history --check`
+    # that gates everything else.
+    if isinstance(summary.get("tuned"), dict):
+        ev["tuned"] = summary["tuned"]
     # Checkpoint-SLO section (tpusnap.slo, recorded at the commit
     # anchor): realized commit interval, the interval's change bytes,
     # and the estimated RTO at commit time. commit_interval_s is a

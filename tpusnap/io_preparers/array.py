@@ -1455,6 +1455,15 @@ class _CompressedConsumer(BufferConsumer):
         self.expected_checksum = expected_checksum
         self.location = location
         self.comp_nbytes = sum(comp_sizes)
+        # Decode-lane attribution: capture the restore's recorder at
+        # construction (prepare_read runs under the restore's telemetry
+        # overlay); _consume_blocking later runs on a consume-executor
+        # thread, where the thread-local overlay is invisible.
+        # record_span is lock-guarded, so recording from that thread is
+        # safe.
+        from .. import telemetry as _telemetry
+
+        self._tele = _telemetry.current()
 
     async def consume_read_io(self, read_io, executor: Optional[Executor] = None) -> None:
         buf = read_io.buf.getbuffer()
@@ -1509,7 +1518,11 @@ class _CompressedConsumer(BufferConsumer):
             return  # zero-size destination: nothing to decode
         from ..compress import codec_elem
 
+        tele = self._tele
+        start = tele.now() if tele is not None else 0.0
         try:
+            # One span site covers native decode AND the Python
+            # fallback — the fallback lives inside decompress_tiles.
             _native.decompress_tiles(
                 mv,
                 self.comp_sizes,
@@ -1519,6 +1532,15 @@ class _CompressedConsumer(BufferConsumer):
                 self.dest_slice,
                 nthreads=get_native_copy_threads(),
             )
+            if tele is not None:
+                tele.record_span(
+                    "restore.decode",
+                    start,
+                    tele.now() - start,
+                    path=self.location,
+                    bytes=self.comp_nbytes,
+                    raw_bytes=self.raw_len,
+                )
         except _native.CompressionError as e:
             raise _native.CompressionError(
                 f"{self.location}: {e} (stored checksum verified — the "

@@ -8,7 +8,8 @@ reference: 512MB max chunk, 512MB max shard, 128MB slab threshold.
 import contextlib
 import logging
 import os
-from typing import Generator, Optional
+import threading
+from typing import Dict, Generator, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -44,6 +45,7 @@ _ASYNC_COW_ENV_VAR = "TPUSNAP_ASYNC_COW"
 _PROBE_ENV_VAR = "TPUSNAP_PROBE"
 _PROBE_INTERVAL_ENV_VAR = "TPUSNAP_PROBE_INTERVAL_BYTES"
 _PROBE_BYTES_ENV_VAR = "TPUSNAP_PROBE_BYTES"
+_AUTOTUNE_ENV_VAR = "TPUSNAP_AUTOTUNE"
 _STAGING_POOL_ENV_VAR = "TPUSNAP_STAGING_POOL_BYTES"
 _LOCKCHECK_ENV_VAR = "TPUSNAP_LOCKCHECK"
 _FLIGHT_ENV_VAR = "TPUSNAP_FLIGHT"
@@ -98,8 +100,66 @@ _DEFAULT_PROBE_BYTES = 64 * 1024 * 1024
 _DEFAULT_STAGING_POOL_BYTES = 4 * 1024 * 1024 * 1024
 
 
-def _get_float_env(name: str, default: float) -> float:
+# ------------------------------------------------- tuned-plan overlay
+#
+# `tpusnap tune` reconcile seam (TPUSNAP_AUTOTUNE=1): an applied plan's
+# knob values live HERE, one layer below the environment, and every
+# knob lookup consults the env first — so an explicitly-set env var
+# always beats the tuner, per lookup, with no copying of tuner values
+# into os.environ (which a later explicit `export` could not then
+# override, and which child processes would inherit as if the operator
+# had set them).
+_tuned_lock = threading.Lock()
+_tuned_overlay: Dict[str, str] = {}
+_tuned_plan_id: Optional[str] = None
+
+
+def apply_tuned_plan(plan_id: str, knobs: Dict[str, str]) -> Dict[str, str]:
+    """Install a tuner plan's knob values as the fallback layer. Knobs
+    the environment already sets explicitly are SKIPPED (explicit env
+    always wins). Returns the subset actually applied — what the
+    take/restore stamps into its history event as ``tuned.knobs``."""
+    applied: Dict[str, str] = {}
+    with _tuned_lock:
+        global _tuned_plan_id
+        _tuned_overlay.clear()
+        for name, value in knobs.items():
+            if name in os.environ:
+                continue
+            _tuned_overlay[name] = str(value)
+            applied[name] = str(value)
+        _tuned_plan_id = plan_id if applied else None
+    return applied
+
+
+def clear_tuned_plan() -> None:
+    with _tuned_lock:
+        global _tuned_plan_id
+        _tuned_overlay.clear()
+        _tuned_plan_id = None
+
+
+def tuned_plan() -> Optional[Dict[str, object]]:
+    """The currently-applied plan (``{plan_id, knobs}``) or None."""
+    with _tuned_lock:
+        if _tuned_plan_id is None or not _tuned_overlay:
+            return None
+        return {"plan_id": _tuned_plan_id, "knobs": dict(_tuned_overlay)}
+
+
+def _env_get(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Knob lookup: explicit environment first, then the applied tuner
+    plan, then the default."""
     val = os.environ.get(name)
+    if val is not None:
+        return val
+    with _tuned_lock:
+        val = _tuned_overlay.get(name)
+    return val if val is not None else default
+
+
+def _get_float_env(name: str, default: float) -> float:
+    val = _env_get(name)
     if val is None:
         return default
     try:
@@ -110,7 +170,7 @@ def _get_float_env(name: str, default: float) -> float:
 
 
 def _get_int_env(name: str, default: int) -> int:
-    val = os.environ.get(name)
+    val = _env_get(name)
     if val is None:
         return default
     try:
@@ -398,8 +458,21 @@ def is_probe_enabled() -> bool:
     storage ceiling and carries a drift-immune ``roofline_fraction`` in
     its summary, rollup and history event. Opt-in because the probes
     cost real I/O (bounded by PROBE_BYTES/PROBE_INTERVAL, ~3% at the
-    defaults) and only run when telemetry is enabled."""
-    return os.environ.get(_PROBE_ENV_VAR, "0") == "1"
+    defaults) and only run when telemetry is enabled. The restore
+    scheduler runs the same probes between its read windows, feeding
+    ``restore_roofline_fraction`` from the read leg."""
+    return _env_get(_PROBE_ENV_VAR, "0") == "1"
+
+
+def is_autotune_enabled() -> bool:
+    """``TPUSNAP_AUTOTUNE=1`` (off by default): at take/restore begin,
+    compute the `tpusnap tune` plan for this backend/kind/world-size
+    cell from the local history and apply it through the tuned-plan
+    overlay. Explicit env vars always win over the plan; the knobs a
+    run actually applied are stamped into its history event as
+    ``tuned: {plan_id, knobs}`` so `history --check` can attribute (and
+    gate) any regression the tuner causes."""
+    return _env_get(_AUTOTUNE_ENV_VAR, "0") == "1"
 
 
 def get_probe_interval_bytes() -> int:
@@ -593,7 +666,7 @@ def get_compress_mode() -> str:
       the name exists so a future codec can be pinned explicitly).
 
     Unknown values warn once per process and fall back to ``auto``."""
-    raw = os.environ.get(_COMPRESS_ENV_VAR, "auto").strip().lower()
+    raw = (_env_get(_COMPRESS_ENV_VAR) or "auto").strip().lower()
     if raw not in _KNOWN_COMPRESS_MODES:
         if raw not in _warned_compress_modes:
             _warned_compress_modes.add(raw)
@@ -727,7 +800,7 @@ def is_lockcheck_enabled() -> bool:
 
 
 def get_memory_budget_override_bytes() -> Optional[int]:
-    if _MEMORY_BUDGET_ENV_VAR not in os.environ:
+    if _env_get(_MEMORY_BUDGET_ENV_VAR) is None:
         return None
     val = _get_int_env(_MEMORY_BUDGET_ENV_VAR, -1)
     return val if val > 0 else None
@@ -1172,4 +1245,11 @@ def override_probe(
             stack.enter_context(
                 _override_env(_PROBE_BYTES_ENV_VAR, str(probe_bytes))
             )
+        yield
+
+
+@contextlib.contextmanager
+def override_autotune(enabled: bool) -> Generator[None, None, None]:
+    """Enable/disable the take/restore-begin auto-tuner reconcile."""
+    with _override_env(_AUTOTUNE_ENV_VAR, "1" if enabled else "0"):
         yield

@@ -183,6 +183,13 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
                 v = (summary.get("gauges") or {}).get(g)
                 if v is not None:
                     self._last_gauges[g] = float(v)
+            # Probe-derived roofline fractions ride as top-level summary
+            # fields, not gauges: write lane from takes, read lane from
+            # restores (TPUSNAP_PROBE=1 only — absent otherwise).
+            for f in ("roofline_fraction", "restore_roofline_fraction"):
+                v = summary.get(f)
+                if isinstance(v, (int, float)):
+                    self._last_gauges[f] = float(v)
             self._rewrite_locked()
 
     def _rewrite_locked(self) -> None:
@@ -344,6 +351,22 @@ class PrometheusTextfileSink(telemetry.MetricsSink):
                 "gauge",
                 "Peak RSS delta sampled over the last take/restore.",
                 [({}, self._last_gauges["peak_rss_delta_bytes"])],
+            )
+        if "roofline_fraction" in self._last_gauges:
+            metric(
+                "tpusnap_roofline_fraction",
+                "gauge",
+                "Last take's payload throughput as a fraction of the "
+                "in-take probe WRITE ceiling (TPUSNAP_PROBE=1).",
+                [({}, self._last_gauges["roofline_fraction"])],
+            )
+        if "restore_roofline_fraction" in self._last_gauges:
+            metric(
+                "tpusnap_restore_roofline_fraction",
+                "gauge",
+                "Last restore's payload throughput as a fraction of "
+                "the in-restore probe READ ceiling (TPUSNAP_PROBE=1).",
+                [({}, self._last_gauges["restore_roofline_fraction"])],
             )
         # Checkpoint-SLO gauges (tpusnap.slo): the per-rank view, plus
         # rank 0's fleet worst-case fold as scope="fleet" samples.

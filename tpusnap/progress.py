@@ -49,8 +49,10 @@ import hashlib
 import json
 import logging
 import os
+import re
 import threading
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from .knobs import (
@@ -607,18 +609,34 @@ def restore_trace_dir(snapshot_path: str) -> str:
     )
 
 
+# Run-scoped trace files kept per digest+rank before the oldest are
+# reaped. Back-to-back restores of the same snapshot used to clobber
+# each other's rank_<k>.json; now each run writes its own suffixed file
+# and only the `latest` pointer moves.
+RESTORE_TRACE_KEEP = 8
+
+_RANK_LATEST_RE = re.compile(r"^rank_(\d+)\.json$")
+
+
 def persist_restore_trace(tele, snapshot_path: str) -> str:
     """Write one rank's restore trace
-    (``{rank, path, summary, traceEvents}``) under the local trace dir,
-    atomically; each restore overwrites the previous one's file for the
-    same snapshot path + rank. Returns the file path."""
+    (``{rank, path, summary, traceEvents}``) under the local trace dir.
+    Each restore run writes its own ``rank_<k>.<run>.json`` (atomic
+    temp+rename) and repoints the ``rank_<k>.json`` latest-symlink at
+    it, so back-to-back restores of the same snapshot no longer clobber
+    each other while ``trace --restore`` keeps reading the latest run
+    through the unchanged name. Retention is bounded to
+    :data:`RESTORE_TRACE_KEEP` runs per digest+rank. Returns the
+    run-scoped file path."""
     tdir = restore_trace_dir(snapshot_path)
     os.makedirs(tdir, exist_ok=True)
-    out = os.path.join(tdir, f"rank_{tele.rank}.json")
+    run_id = uuid.uuid4().hex[:8]
+    out = os.path.join(tdir, f"rank_{tele.rank}.{run_id}.json")
     doc = {
         "rank": tele.rank,
         "path": snapshot_path,
         "kind": "restore",
+        "run_id": run_id,
         "summary": tele.summary(),
         "traceEvents": tele.chrome_trace_events(),
     }
@@ -626,13 +644,59 @@ def persist_restore_trace(tele, snapshot_path: str) -> str:
     with open(tmp, "w") as f:
         json.dump(doc, f)
     os.replace(tmp, out)
+    latest = os.path.join(tdir, f"rank_{tele.rank}.json")
+    try:
+        # Atomic repoint: build the new symlink aside, rename over the
+        # old one (rename replaces symlinks like any other entry).
+        link_tmp = f"{latest}.lnk.{os.getpid()}"
+        try:
+            os.unlink(link_tmp)
+        except OSError:
+            pass
+        os.symlink(os.path.basename(out), link_tmp)
+        os.replace(link_tmp, latest)
+    except OSError:
+        # Symlink-hostile filesystem: fall back to the pre-fix overwrite
+        # semantics for the latest pointer (run files are still kept).
+        tmp2 = f"{latest}.tmp.{os.getpid()}"
+        with open(tmp2, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp2, latest)
+    _reap_restore_traces(tdir, tele.rank)
     return out
+
+
+def _reap_restore_traces(tdir: str, rank: int) -> None:
+    """Drop this rank's oldest run-scoped trace files beyond the
+    retention bound (best-effort; the latest-symlink's target is never
+    younger than the survivors, so it stays valid)."""
+    pat = re.compile(rf"^rank_{rank}\.[0-9a-f]+\.json$")
+    try:
+        runs = [n for n in os.listdir(tdir) if pat.match(n)]
+    except OSError:
+        return
+    if len(runs) <= RESTORE_TRACE_KEEP:
+        return
+    dated = []
+    for name in runs:
+        try:
+            dated.append((os.stat(os.path.join(tdir, name)).st_mtime, name))
+        except OSError:
+            continue
+    dated.sort()
+    for _, name in dated[: max(len(dated) - RESTORE_TRACE_KEEP, 0)]:
+        try:
+            os.unlink(os.path.join(tdir, name))
+        except OSError:
+            pass
 
 
 def load_restore_traces(snapshot_path: str) -> Dict[int, Dict[str, Any]]:
     """Per-rank restore trace docs persisted on THIS machine for
     ``snapshot_path`` (restore issues no collectives, so there is no
-    cross-host gather — each host holds its own ranks' traces)."""
+    cross-host gather — each host holds its own ranks' traces). Reads
+    each rank's ``rank_<k>.json`` latest pointer — run-scoped files
+    from older restores are retained on disk but not returned."""
     tdir = restore_trace_dir(snapshot_path)
     out: Dict[int, Dict[str, Any]] = {}
     try:
@@ -640,7 +704,7 @@ def load_restore_traces(snapshot_path: str) -> Dict[int, Dict[str, Any]]:
     except OSError:
         return out
     for name in sorted(names):
-        if not (name.startswith("rank_") and name.endswith(".json")):
+        if not _RANK_LATEST_RE.match(name):
             continue
         try:
             with open(os.path.join(tdir, name), "r") as f:

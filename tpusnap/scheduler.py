@@ -241,19 +241,22 @@ class _Reporter:
 
 
 class _ProbeRunner:
-    """In-take roofline probes (``TPUSNAP_PROBE=1``): between I/O
-    windows — once per TPUSNAP_PROBE_INTERVAL_BYTES of payload writes,
-    while no blob write is in flight — write (then read back, then
-    delete) TPUSNAP_PROBE_BYTES of raw data through the take's OWN
-    storage plugin stack, across a few concurrent streams, and record
-    the aggregate throughput as a probe sample. The take's summary
-    derives ``roofline_fraction`` from these samples: a ceiling
-    measured seconds (not minutes) from the writes it judges, immune to
-    the multi-minute disk drift that made separate full-scale roofline
-    sessions scatter 3x (ROADMAP 5a). Probe files live under
-    ``.tpusnap/probe/`` (journal-exempt sidecar space; a crash's
+    """In-take/in-restore roofline probes (``TPUSNAP_PROBE=1``):
+    between I/O windows — once per TPUSNAP_PROBE_INTERVAL_BYTES of
+    payload traffic, while no blob I/O is in flight — write (then read
+    back, then delete) TPUSNAP_PROBE_BYTES of raw data through the
+    operation's OWN storage plugin stack, across a few concurrent
+    streams, and record the aggregate throughput as a probe sample.
+    Each sample times BOTH legs: the take's summary derives
+    ``roofline_fraction`` from the write leg, the restore's
+    ``restore_roofline_fraction`` from the read leg — ceilings measured
+    seconds (not minutes) from the I/O they judge, immune to the
+    multi-minute disk drift that made separate full-scale roofline
+    sessions scatter 3x (ROADMAP 5a). On the restore side the probe
+    still writes its own scratch (the snapshot's blobs are immutable),
+    under ``.tpusnap/probe/`` (journal-exempt sidecar space; a crash's
     leftovers are orphan-visible to fsck/gc). Failures never fail the
-    take — a failed probe is one missing sample."""
+    take or restore — a failed probe is one missing sample."""
 
     _STREAMS = 4
 
@@ -366,6 +369,9 @@ class _ProbeRunner:
         from . import compress as _compress
 
         _compress.note_pipe_ceiling(self._label, sample["write_gbps"])
+        _compress.note_pipe_ceiling(
+            self._label, sample["read_gbps"], lane="read"
+        )
         self.tele.add_probe_sample(sample)
         self.tele.record_span("probe_roofline", start, elapsed, **sample)
         telemetry.incr("probe.probes", rec=self.tele)
@@ -1076,6 +1082,7 @@ class _ReadPipeline:
             cost = min(cost, storage.in_place_read_overhead_bytes(cost))
         self.consuming_cost = cost
         self.read_io: Optional[ReadIO] = None
+        self.read_nbytes = 0
 
     def _read_nbytes(self) -> int:
         br = self.read_req.byte_range
@@ -1105,6 +1112,7 @@ class _ReadPipeline:
             if self.tele is not None:
                 self.tele.op_exit(token)
         nbytes = self._read_nbytes()
+        self.read_nbytes = nbytes
         if self.tele is not None:
             self.tele.record_span(
                 "storage_read",
@@ -1165,6 +1173,20 @@ async def execute_read_reqs(
     budget = memory_budget_bytes
     read_tasks: Set[asyncio.Task] = set()
     consume_tasks: Set[asyncio.Task] = set()
+    # In-restore roofline probes (TPUSNAP_PROBE=1): the same runner the
+    # write scheduler uses — a probe segment writes its own scratch
+    # streams under .tpusnap/probe/ and times both legs, so the READ leg
+    # measured through this restore's composed plugin stack becomes the
+    # ceiling `restore_roofline_fraction` divides by. Cadence counts
+    # payload bytes READ; a probe never overlaps blob reads (dispatch
+    # parks while one is due) and never consumes memory budget.
+    from .knobs import is_probe_enabled
+
+    probe = (
+        _ProbeRunner(storage, rank, tele)
+        if tele is not None and tele.enabled and is_probe_enabled()
+        else None
+    )
 
     # NOTE on destination prefaulting: a background thread first-touching
     # not-yet-dispatched ``into`` buffers (overlapping page faults with
@@ -1177,6 +1199,12 @@ async def execute_read_reqs(
     def dispatch_reads() -> None:
         nonlocal budget
         while pipelines and len(read_tasks) < _MAX_IO_CONCURRENCY:
+            if probe is not None and probe.due:
+                # Park new reads until the in-flight window drains and
+                # the probe runs: probe traffic sharing the pipe with
+                # blob reads would corrupt both the sample and the
+                # storage_read spans analyze attributes.
+                break
             head = pipelines[0]
             in_flight = read_tasks or consume_tasks
             if head.consuming_cost > budget and in_flight:
@@ -1196,6 +1224,8 @@ async def execute_read_reqs(
                 if task in read_tasks:
                     read_tasks.discard(task)
                     pipeline = task.result()
+                    if probe is not None:
+                        probe.note_written(pipeline.read_nbytes)
                     consume_tasks.add(
                         asyncio.ensure_future(pipeline.consume(executor))
                     )
@@ -1204,6 +1234,11 @@ async def execute_read_reqs(
                     pipeline = task.result()
                     budget += pipeline.consuming_cost
                     reporter.report_request_done(pipeline.consuming_cost)
+            if probe is not None and probe.due and not read_tasks:
+                # The read window drained (consumes may still run —
+                # they are CPU-side and don't touch the pipe being
+                # measured); take the sample, then dispatch resumes.
+                await probe.run()
             dispatch_reads()
             reporter.stage_counts = {
                 "ready_for_read": len(pipelines),
@@ -1211,6 +1246,15 @@ async def execute_read_reqs(
                 "consume": len(consume_tasks),
             }
             reporter.budget_remaining = budget
+        if (
+            probe is not None
+            and probe.ran == 0
+            and not probe._failed
+            and reporter.bytes_done > 0
+        ):
+            # Restore smaller than the probe interval: still measure
+            # once, so no probe-enabled restore is fraction-less.
+            await probe.run()
     except BaseException:
         # Mirror the write path: a failed request (e.g. checksum
         # mismatch) must not abandon in-flight tasks — orphans would be

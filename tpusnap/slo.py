@@ -114,6 +114,10 @@ class RTOEstimate:
     read_gbps: Optional[float] = None
     overhead_s: Optional[float] = None
     n_baseline: int = 0
+    # What priced the estimate: "history" (trailing restore medians) or
+    # "probe" (the read-lane pipe ceiling — the cold-start fallback when
+    # no comparable restore has run on this host yet).
+    source: str = "history"
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -123,6 +127,7 @@ class RTOEstimate:
             "read_gbps": self.read_gbps,
             "overhead_s": self.overhead_s,
             "n_baseline": self.n_baseline,
+            "source": self.source,
         }
 
 
@@ -167,6 +172,44 @@ def _load_recent_restore_events(
     return out
 
 
+def _probe_read_ceiling(
+    backend: Optional[str], events: List[Dict[str, Any]]
+) -> Optional[float]:
+    """Best read-lane throughput ceiling available without restore
+    history: the in-process probe registry first (populated when
+    TPUSNAP_PROBE ran in this process — the sidecar path), else the
+    median ``probe_read_gbps`` of whatever history events exist (the
+    CLI path, where the registry is empty). Backend labels in the
+    registry may carry a tier suffix (``Plugin@tier``), hence the
+    prefix match."""
+    try:
+        from .compress import pipe_ceilings_snapshot
+
+        matches = [
+            gbps
+            for (label, lane), gbps in pipe_ceilings_snapshot().items()
+            if lane == "read"
+            and (
+                backend is None
+                or label == backend
+                or label.startswith(backend + "@")
+            )
+        ]
+        if matches:
+            return max(matches)
+    except Exception:
+        pass
+    vals = [
+        float(e["probe_read_gbps"])
+        for e in events
+        if isinstance(e.get("probe_read_gbps"), (int, float))
+        and (backend is None or e.get("plugin") == backend)
+    ]
+    if vals:
+        return statistics.median(vals)
+    return None
+
+
 def estimate_rto(
     snapshot_bytes: int,
     events: Optional[List[Dict[str, Any]]] = None,
@@ -206,6 +249,27 @@ def estimate_rto(
         and (e.get("wall_s") or 0) > 0
     ][-window:]
     if len(cand) < max(1, min_baseline):
+        # Cold-start fallback: a host that has never restored still has a
+        # read-lane probe ceiling if TPUSNAP_PROBE ran during any take or
+        # restore — the probe streams through the same composed plugin
+        # stack, so bytes/ceiling is an honest (overhead-free, hence
+        # optimistic) RTO floor. Better labelled "probe" than exit-3.
+        ceiling = _probe_read_ceiling(backend, events)
+        if ceiling is not None and ceiling > 0:
+            return RTOEstimate(
+                ok=True,
+                reason=(
+                    f"probe read ceiling {ceiling:.2f} GB/s"
+                    + (f" for backend {backend}" if backend else "")
+                    + f" (only {len(cand)} comparable restore event(s); "
+                    "no per-restore overhead term)"
+                ),
+                seconds=round(snapshot_bytes / 1e9 / ceiling, 3),
+                read_gbps=round(ceiling, 4),
+                overhead_s=0.0,
+                n_baseline=len(cand),
+                source="probe",
+            )
         return RTOEstimate(
             ok=False,
             reason=(
@@ -641,6 +705,7 @@ class SLOTracker:
                 "estimated_rto_s": rto.seconds if rto.ok else None,
                 "rto_read_gbps": rto.read_gbps if rto.ok else None,
                 "rto_n_baseline": rto.n_baseline,
+                "rto_source": rto.source if rto.ok else None,
                 "stream_cadence_s": self._stream_cadence_s,
                 # Peer ranks the liveness layer declared dead during
                 # the current take (tpusnap.liveness) — the slo CLI's
@@ -966,6 +1031,9 @@ def evaluate_records(
             "since_commit_s": round(since_commit, 2),
             "data_at_risk_bytes": int(rec.get("data_at_risk_bytes") or 0),
             "estimated_rto_s": rto,
+            # "history" (restore-event medians) or "probe" (read-lane
+            # ceiling cold-start fallback — no overhead term, optimistic).
+            "rto_source": rec.get("rto_source"),
             "record_age_s": round(max(now - (rec.get("ts") or now), 0.0), 2),
             "committed": rec.get("last_commit_ts") is not None,
             "fleet": fleet or None,

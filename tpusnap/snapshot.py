@@ -652,6 +652,11 @@ class Snapshot:
             # trend point; the summary itself still publishes either way.
             tele.meta["completed"] = True
         finally:
+            # The tuned overlay is scoped to the operation that applied
+            # it — knob reads after the restore see the plain env again.
+            from .knobs import clear_tuned_plan
+
+            clear_tuned_plan()
             tele.finalize()
             summary = tele.summary()
             telemetry.publish_restore_summary(summary)
@@ -679,6 +684,17 @@ class Snapshot:
             telemetry.current().meta["plugin"] = storage_plugin_label(storage)
         except Exception:
             pass
+        # Auto-tuner reconcile (TPUSNAP_AUTOTUNE=1): install this
+        # cell's plan BEFORE the budget/knob reads below, so the
+        # restore runs with the tuned values; the applied subset rides
+        # the summary into the history event for attribution.
+        from . import tune as _tune
+
+        tuned = _tune.maybe_apply(
+            "restore", storage=storage, world_size=comm.world_size
+        )
+        if tuned:
+            telemetry.current().meta["tuned"] = tuned
         metadata = self._get_metadata(storage, event_loop)
         if memory_budget is None:
             memory_budget = get_process_memory_budget_bytes(comm)
@@ -1228,6 +1244,30 @@ def _take_impl(
     storage = url_to_storage_plugin_in_event_loop(
         path, event_loop, storage_options
     )
+    try:
+        from .storage_plugin import storage_plugin_label
+
+        # Which backend this take writes (innermost plugin class):
+        # stamps the history event's `plugin` field — the tune
+        # planner's cell key, and what keeps local-NVMe medians from
+        # pricing cloud takes (restores have stamped it since PR 12).
+        telemetry.current().meta["plugin"] = storage_plugin_label(storage)
+    except Exception:
+        pass
+    # Auto-tuner reconcile (TPUSNAP_AUTOTUNE=1): install this cell's
+    # plan BEFORE the staging/window/budget knob reads below; explicit
+    # env vars win knob-by-knob, and the applied subset rides the
+    # summary into the history event for attribution.
+    from . import tune as _tune
+
+    _tuned = _tune.maybe_apply(
+        "take", storage=storage, world_size=comm.world_size
+    )
+    if _tuned:
+        try:
+            telemetry.current().meta["tuned"] = _tuned
+        except Exception:
+            pass
     # Crash-safe lifecycle (tpusnap.lifecycle): if the destination holds
     # a TORN take (journal present, no committed metadata), load its
     # completion records — staged blobs whose dual hash matches skip

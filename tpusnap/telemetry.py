@@ -708,18 +708,27 @@ class TakeTelemetry:
             out["io_histograms"] = io_hist
         if probes:
             out["probe"] = probe_aggregate(probes)
-            # Drift-immune in-take roofline fraction: the take's payload
+            # Drift-immune roofline fraction: the operation's payload
             # throughput over its NON-PROBE wall-clock, against the
             # ceiling the interleaved probes measured through the same
             # engine moments apart — no separate roofline session whose
-            # disk window the take never shared.
-            ceiling = out["probe"].get("write_gbps_p50")
-            payload = counters.get("storage.bytes_written", 0)
+            # disk window the take never shared. Takes judge the write
+            # leg; restores judge the read leg.
             adj_wall = max(take_wall - out["probe"].get("elapsed_s", 0.0), 1e-9)
-            if ceiling and payload:
-                out["roofline_fraction"] = round(
-                    (payload / adj_wall / 1e9) / ceiling, 4
-                )
+            if self.meta.get("kind") == "restore":
+                ceiling = out["probe"].get("read_gbps_p50")
+                payload = counters.get("storage.bytes_read", 0)
+                if ceiling and payload:
+                    out["restore_roofline_fraction"] = round(
+                        (payload / adj_wall / 1e9) / ceiling, 4
+                    )
+            else:
+                ceiling = out["probe"].get("write_gbps_p50")
+                payload = counters.get("storage.bytes_written", 0)
+                if ceiling and payload:
+                    out["roofline_fraction"] = round(
+                        (payload / adj_wall / 1e9) / ceiling, 4
+                    )
         return out
 
     def chrome_trace_events(self) -> List[Dict[str, Any]]:
@@ -865,6 +874,16 @@ def end_take(rec: TakeTelemetry) -> None:
     summary: LAST_TAKE_SUMMARY, the sinks' on_take_summary, and — for
     COMPLETED takes only — one cross-run history event."""
     global LAST_TAKE_SUMMARY
+    # The auto-tuner's overlay is scoped to the take that applied it
+    # (end_take is the chokepoint every take path — sync, async,
+    # aborted — funnels through); knob reads afterwards see the plain
+    # environment again. The summary below still carries meta["tuned"].
+    try:
+        from .knobs import clear_tuned_plan
+
+        clear_tuned_plan()
+    except Exception:
+        pass
     rec.finalize()
     release_global(rec)
     summary = rec.summary()
@@ -1048,29 +1067,35 @@ def rollup_summaries(summaries: List[Dict[str, Any]]) -> Dict[str, Any]:
     )
     if io_merged:
         out["io_histograms"] = io_merged
-    # In-take roofline probes: the p50 fraction across ranks (the fleet
+    # Roofline probes: the p50 fraction across ranks (the fleet
     # headline) plus the worst rank's, with its id (a single rank's slow
-    # disk is a straggler story, not a fleet story).
-    fracs = sorted(
-        (s["roofline_fraction"], s.get("rank", i))
-        for i, s in enumerate(summaries)
-        if isinstance(s.get("roofline_fraction"), (int, float))
-    )
-    if fracs:
-        out["roofline_fraction"] = round(fracs[len(fracs) // 2][0], 4)
-        out["roofline_fraction_min"] = round(fracs[0][0], 4)
-        out["roofline_fraction_min_rank"] = fracs[0][1]
+    # disk is a straggler story, not a fleet story). Takes fold
+    # ``roofline_fraction`` (write lane), restores fold
+    # ``restore_roofline_fraction`` (read lane) — same shape.
+    any_fracs = False
+    for field in ("roofline_fraction", "restore_roofline_fraction"):
+        fracs = sorted(
+            (s[field], s.get("rank", i))
+            for i, s in enumerate(summaries)
+            if isinstance(s.get(field), (int, float))
+        )
+        if not fracs:
+            continue
+        any_fracs = True
+        out[field] = round(fracs[len(fracs) // 2][0], 4)
+        out[f"{field}_min"] = round(fracs[0][0], 4)
+        out[f"{field}_min_rank"] = fracs[0][1]
+    if any_fracs:
         probe_ranks = [s["probe"] for s in summaries if s.get("probe")]
         if probe_ranks:
-            ceilings = sorted(
-                p["write_gbps_p50"]
-                for p in probe_ranks
-                if p.get("write_gbps_p50")
-            )
             out["probe"] = {
-                "probes": sum(p.get("probes", 0) for p in probe_ranks),
-                "write_gbps_p50": (
-                    round(ceilings[len(ceilings) // 2], 4) if ceilings else None
-                ),
+                "probes": sum(p.get("probes", 0) for p in probe_ranks)
             }
+            for lane in ("write_gbps_p50", "read_gbps_p50"):
+                ceilings = sorted(
+                    p[lane] for p in probe_ranks if p.get(lane)
+                )
+                out["probe"][lane] = (
+                    round(ceilings[len(ceilings) // 2], 4) if ceilings else None
+                )
     return out
