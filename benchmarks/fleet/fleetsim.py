@@ -174,10 +174,38 @@ def run_restorer(args) -> dict:
     return {"committed": 1 + restores, "takes": args.takes}
 
 
+def run_readseed(args) -> dict:
+    """Takes the ONE shared snapshot the reader cohort serves from
+    (plain fs — the read-attribution grade must not ride chaos)."""
+    from tpusnap import Snapshot
+
+    state = _mk_state(args.mb, args.seed + 97)
+    Snapshot.take(f"{args.base}/shared/seed", state)
+    return {"committed": 1, "takes": 1}
+
+
+def run_reader(args) -> dict:
+    """A serving reader over the shared seed snapshot: full restores,
+    each attributed by the access ledger into the SHARED telemetry dir
+    — the parent grades the cohort's merged ledgers through
+    ``tpusnap heatmap --check`` and ``fleet --check``."""
+    from tpusnap import Snapshot
+
+    state = _mk_state(args.mb, args.seed + 97)
+    snap = Snapshot(f"{args.base}/shared/seed")
+    restores = 0
+    for _ in range(max(args.takes, 1)):
+        snap.restore(state)
+        restores += 1
+        time.sleep(args.pause)
+    return {"committed": 0, "restores": restores, "takes": args.takes}
+
+
 def child_main(args) -> int:
     t0 = time.time()
     fn = {"trainer": run_trainer, "stream": run_stream,
-          "restore": run_restorer, "branch": run_brancher}[args.role]
+          "restore": run_restorer, "branch": run_brancher,
+          "readseed": run_readseed, "reader": run_reader}[args.role]
     out = {"job": args.job, "role": args.role, "ok": False}
     try:
         out.update(fn(args))
@@ -220,6 +248,14 @@ def spawn_job(args, index: int, role: str, base: str, fleet_dir: str):
             env["TPUSNAP_FAULT_SPEC"] = spec
     elif role == "stream":
         env["TPUSNAP_FAULT_SPEC"] = STREAM_FAULT
+    elif role in ("reader", "readseed"):
+        # The whole cohort shares ONE telemetry dir: every reader's
+        # access ledger lands under the same access/<digest>/ so the
+        # parent's heatmap merge sees all of them. Job ids stay
+        # distinct (TPUSNAP_JOB_ID), so ledger files never collide.
+        env["TPUSNAP_TELEMETRY_DIR"] = os.path.join(
+            base, "telemetry", "readers"
+        )
     elif role == "branch":
         # Branchers share one content-addressed store; their snapshot
         # side rides seeded transient faults (survivable by design).
@@ -264,6 +300,11 @@ def main() -> int:
     parser.add_argument("--branch", type=int, default=4,
                         help="shared-base branching jobs through one "
                         "content-addressed store (0 disables; default 4)")
+    parser.add_argument("--readers", type=int, default=0,
+                        help="serving-reader jobs restoring ONE shared "
+                        "snapshot; their merged access ledgers are "
+                        "graded through heatmap --check and the fleet "
+                        "read-amplification gate (0 disables)")
     parser.add_argument("--kill-after", type=int, default=1, dest="kill_after",
                         help="SIGKILL the doomed trainer after its Nth "
                         "remote payload write (per-take plugin "
@@ -301,9 +342,25 @@ def main() -> int:
         jobs.append(
             spawn_job(args, n_trainers + 2 + b, "branch", base, fleet_dir)
         )
+    if args.readers:
+        # The shared seed must be committed before any reader starts —
+        # run the seeding job to completion first (synchronously).
+        seed = spawn_job(args, 0, "readseed", base, fleet_dir)
+        try:
+            seed["proc"].communicate(timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            seed["proc"].kill()
+        if seed["proc"].returncode != 0:
+            print("readseed: FAILED — skipping the reader cohort")
+        else:
+            for r in range(args.readers):
+                jobs.append(
+                    spawn_job(args, r, "reader", base, fleet_dir)
+                )
     print(f"fleet: {len(jobs)} job(s) under {base} "
           f"(faults on trainers 0-3 + the stream; trainer 1 is doomed; "
-          f"{args.branch} branch job(s) share one CAS store)")
+          f"{args.branch} branch job(s) share one CAS store; "
+          f"{args.readers} reader(s) on one shared snapshot)")
 
     # Babysit: SIGCONT the wedged job each poll (a running process
     # ignores SIGCONT, a SIGSTOPped one resumes — bounding the freeze
@@ -361,8 +418,16 @@ def main() -> int:
     # Grade 1: the fleet gate over what every job published. Thresholds
     # are generous — the seeded faults are survivable; the gate exists
     # to catch jobs that silently never published or never committed.
-    rc, out, err = cli(["fleet", "--dir", fleet_dir, "--json", "--check",
-                        "--rpo", "3600", "--lag-s", "3600"])
+    n_readers = sum(1 for j in jobs if j["role"] == "reader")
+    fleet_cmd = ["fleet", "--dir", fleet_dir, "--json", "--check",
+                 "--rpo", "3600", "--lag-s", "3600"]
+    if n_readers:
+        # Each reader restores the shared snapshot --takes times, so the
+        # merged amplification is ~readers*takes; +1 of slack keeps the
+        # gate about attribution working, not scheduling jitter.
+        fleet_cmd += ["--max-read-amplification",
+                      str(n_readers * max(args.takes, 1) + 1)]
+    rc, out, err = cli(fleet_cmd)
     fleet_doc = json.loads(out) if rc in (0, 2, 3) and out else {}
     rollup = fleet_doc.get("rollup") or {}
     print(f"\nfleet --check: rc={rc} "
@@ -418,6 +483,41 @@ def main() -> int:
             if err_s.strip():
                 print(err_s.strip())
 
+    # Grade: the reader cohort's merged access ledgers. Every reader's
+    # full restore must be attributed (n_readers distinct jobs in the
+    # heatmap), coverage must be ~complete, and the merged amplification
+    # rides the same generous budget as the fleet gate.
+    heatmap_doc = {}
+    if n_readers:
+        reader_env = dict(
+            os.environ,
+            TPUSNAP_TELEMETRY_DIR=os.path.join(base, "telemetry", "readers"),
+        )
+        shared = os.path.join(base, "shared", "seed")
+        amp_budget = n_readers * max(args.takes, 1) + 1
+        rc_hm, out_hm, err_hm = cli(
+            ["heatmap", shared, "--json", "--check",
+             "--max-amplification", str(amp_budget)],
+            env=reader_env,
+        )
+        try:
+            heatmap_doc = json.loads(out_hm) if out_hm else {}
+        except ValueError:
+            heatmap_doc = {}
+        print(f"\nheatmap --check: rc={rc_hm} — "
+              f"{heatmap_doc.get('n_readers', 0)} reader(s), coverage "
+              f"{heatmap_doc.get('coverage')}, amplification "
+              f"{heatmap_doc.get('amplification')} (budget {amp_budget}x)")
+        if rc_hm != 0:
+            failures.append(f"heatmap-check-rc{rc_hm}")
+            if err_hm.strip():
+                print(err_hm.strip())
+        if heatmap_doc.get("n_readers", 0) < n_readers:
+            failures.append(
+                f"heatmap-readers-{heatmap_doc.get('n_readers', 0)}"
+                f"-of-{n_readers}"
+            )
+
     # Grade 2: record the fleet soak as a kind="fleet" history event and
     # run the trend gate over it (exit 3 = first run, no baseline).
     wall = round(time.time() - t0, 2)
@@ -437,6 +537,14 @@ def main() -> int:
         # No _s suffix: higher is better in the trend gate — a dedup
         # regression (ratio falling toward 1.0) trips history --check.
         "cas_dedup_ratio": cas_dedup_ratio,
+        # Reader cohort: attributed readers and the merged cross-reader
+        # amplification over the shared snapshot (None when --readers 0).
+        "readers": rollup.get("readers"),
+        "read_amplification": (
+            heatmap_doc.get("amplification")
+            if heatmap_doc
+            else rollup.get("read_amplification")
+        ),
         "wall_s": wall,
     })
     rc_h, out_h, _ = cli(["history", "--check", "--kind", "fleet",

@@ -79,6 +79,11 @@
 #      a seeded history; then a TPUSNAP_AUTOTUNE=1 restore must stamp
 #      the applied plan (`tuned: {plan_id, knobs}`) into its history
 #      event; hermetic like the other smokes
+#  16. access-ledger heatmap smoke — `tpusnap heatmap` exit contract:
+#      3 with no reader ledgers, 0 after a partial read_object (with
+#      coverage < 100% naming only the read leaf), and 2 under --check
+#      when a 3-reader cohort's merged amplification crosses the
+#      --max-amplification gate; hermetic like the other smokes
 #
 # Usage:
 #   scripts/ci_gate.sh [SNAPSHOT_PATH]
@@ -97,14 +102,14 @@ cd "$(dirname "$0")/.."
 fail() { echo "ci_gate: FAIL — $1" >&2; exit "$2"; }
 
 # ---- 1. static analysis --------------------------------------------------
-echo "ci_gate: [1/15] lint --check (AST invariants)"
+echo "ci_gate: [1/16] lint --check (AST invariants)"
 env JAX_PLATFORMS=cpu python -m tpusnap lint --check
 rc=$?
 [ "$rc" -eq 0 ] || fail "tpusnap lint --check (rc=$rc)" "$rc"
 
 # ---- 2. tier-1 -----------------------------------------------------------
 if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
-    echo "ci_gate: [2/15] tier-1 tests"
+    echo "ci_gate: [2/16] tier-1 tests"
     rm -f /tmp/_t1.log
     # cloud_real excluded here: on a host with the server binaries the
     # real-backend suite belongs to step 8, not inside the fast tier.
@@ -115,11 +120,11 @@ if [ "${TPUSNAP_CI_SKIP_TESTS:-0}" != "1" ]; then
     echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
     [ "$rc" -eq 0 ] || fail "tier-1 tests (rc=$rc)" "$rc"
 else
-    echo "ci_gate: [2/15] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
+    echo "ci_gate: [2/16] tier-1 tests skipped (TPUSNAP_CI_SKIP_TESTS=1)"
 fi
 
 # ---- 3. cross-run history gate ------------------------------------------
-echo "ci_gate: [3/15] history --check (throughput + p99 write latency)"
+echo "ci_gate: [3/16] history --check (throughput + p99 write latency + restore read roofline)"
 for kind in take bench; do
     python -m tpusnap history --check --kind "$kind" \
         --metric throughput_gbps --metric storage_write_p99_s --json
@@ -130,11 +135,22 @@ for kind in take bench; do
         *) fail "history --check --kind $kind regressed (rc=$rc)" "$rc" ;;
     esac
 done
+# Restore lane: restore_roofline_fraction has no _s suffix, so the gate
+# treats it higher-is-better — a read-path efficiency slide (fraction
+# falling against its baseline) trips CI even when wall-clock hides it.
+python -m tpusnap history --check --kind restore \
+    --metric restore_roofline_fraction --metric storage_read_p99_s --json
+rc=$?
+case "$rc" in
+    0) echo "ci_gate: history[restore] OK" ;;
+    3) echo "ci_gate: history[restore] insufficient comparable history (bootstrapping) — pass" ;;
+    *) fail "history --check --kind restore regressed (rc=$rc)" "$rc" ;;
+esac
 
 # ---- 4. analyze doctor on the latest snapshot ---------------------------
 SNAP="${1:-${TPUSNAP_CI_SNAPSHOT:-}}"
 if [ -n "$SNAP" ]; then
-    echo "ci_gate: [4/15] analyze --check $SNAP"
+    echo "ci_gate: [4/16] analyze --check $SNAP"
     python -m tpusnap analyze --check --history --min-read-roofline 0.4 "$SNAP"
     rc=$?
     case "$rc" in
@@ -143,11 +159,11 @@ if [ -n "$SNAP" ]; then
         *) fail "analyze --check $SNAP (rc=$rc)" "$rc" ;;
     esac
 else
-    echo "ci_gate: [4/15] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
+    echo "ci_gate: [4/16] analyze skipped (no snapshot; pass a path or set TPUSNAP_CI_SNAPSHOT)"
 fi
 
 # ---- 5. checkpoint-SLO gate smoke ---------------------------------------
-echo "ci_gate: [5/15] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
+echo "ci_gate: [5/16] slo --check smoke (exit contract: 0 healthy / 2 breach / 3 no records)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, subprocess, sys, tempfile, time
 
@@ -204,7 +220,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "slo --check smoke (rc=$rc)" "$rc"
 
 # ---- 6. delta soak smoke -------------------------------------------------
-echo "ci_gate: [6/15] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
+echo "ci_gate: [6/16] delta soak smoke (stream ~30s: slo --check green, RPO <= 2x cadence; SIGKILL -> torn-tail contracts)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, re, shutil, signal, subprocess, sys, tempfile, time
 
@@ -348,7 +364,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "delta soak smoke (rc=$rc)" "$rc"
 
 # ---- 7. flight-recorder timeline smoke ----------------------------------
-echo "ci_gate: [7/15] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
+echo "ci_gate: [7/16] timeline smoke (exit contract: 0 committed / 4 torn / 3 no data)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, signal, subprocess, sys, tempfile
 
@@ -422,7 +438,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "timeline smoke (rc=$rc)" "$rc"
 
 # ---- 8. write-back tiering smoke ----------------------------------------
-echo "ci_gate: [8/15] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
+echo "ci_gate: [8/16] tiering smoke (local commit -> SIGKILL mid-drain -> resumed drain -> remote-durable)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, signal, subprocess, sys, tempfile
 
@@ -512,7 +528,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "tiering smoke (rc=$rc)" "$rc"
 
 # ---- 9. fused-compression smoke ------------------------------------------
-echo "ci_gate: [9/15] compression smoke (compressed take -> fsck/scrub clean -> bit-exact restore; auto bypasses locally, compresses on a throttled pipe)"
+echo "ci_gate: [9/16] compression smoke (compressed take -> fsck/scrub clean -> bit-exact restore; auto bypasses locally, compresses on a throttled pipe)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import os, shutil, sys, tempfile
 
@@ -623,7 +639,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "compression smoke (rc=$rc)" "$rc"
 
 # ---- 10. rank-failure smoke ----------------------------------------------
-echo "ci_gate: [10/15] rank-failure smoke (chaos rank-kill -> fast RankFailedError; degrade-mode replicated take -> committed + scrub clean)"
+echo "ci_gate: [10/16] rank-failure smoke (chaos rank-kill -> fast RankFailedError; degrade-mode replicated take -> committed + scrub clean)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import atexit, os, re, shutil, subprocess, sys, tempfile
 
@@ -769,7 +785,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "rank-failure smoke (rc=$rc)" "$rc"
 
 # ---- 11. elastic-stream smoke ---------------------------------------------
-echo "ci_gate: [11/15] elastic-stream smoke (2-process stream survives a SIGKILLed rank via a degraded epoch; graceful leave + re-join re-plan the world)"
+echo "ci_gate: [11/16] elastic-stream smoke (2-process stream survives a SIGKILLed rank via a degraded epoch; graceful leave + re-join re-plan the world)"
 env JAX_PLATFORMS=cpu TPUSNAP_HISTORY=0 python -m pytest -q \
     tests/test_stream_elastic.py::test_stream_survives_rank_sigkill \
     tests/test_stream_elastic.py::test_stream_graceful_leave_and_rejoin \
@@ -778,7 +794,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "elastic-stream smoke (rc=$rc)" "$rc"
 
 # ---- 12. fleet observability smoke ----------------------------------------
-echo "ci_gate: [12/15] mini-fleetsim smoke (3 jobs, rank-kill + outage faults; fleet --check exit contract: 0 healthy / 2 breach / 3 no records)"
+echo "ci_gate: [12/16] mini-fleetsim smoke (3 jobs, rank-kill + outage faults; fleet --check exit contract: 0 healthy / 2 breach / 3 no records)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import atexit, json, os, shutil, signal, subprocess, sys, tempfile, time
 
@@ -883,7 +899,7 @@ rc=$?
 [ "$rc" -eq 0 ] || fail "mini-fleetsim smoke (rc=$rc)" "$rc"
 
 # ---- 13. content-addressed store smoke ------------------------------------
-echo "ci_gate: [13/15] CAS smoke (two jobs share a base through one store; SIGKILL mid-gc-sweep -> re-run gc converges -> fsck --store exit 0)"
+echo "ci_gate: [13/16] CAS smoke (two jobs share a base through one store; SIGKILL mid-gc-sweep -> re-run gc converges -> fsck --store exit 0)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import atexit, os, shutil, signal, subprocess, sys, tempfile, time
 
@@ -978,7 +994,7 @@ rc=$?
 
 # ---- 14. optional real-backend cloud suite -------------------------------
 if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&1; then
-    echo "ci_gate: [14/15] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
+    echo "ci_gate: [14/16] real-backend cloud suite (fake-gcs-server/minio found on PATH)"
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m cloud_real \
         -p no:cacheprovider -p no:xdist -p no:randomly
     rc=$?
@@ -988,11 +1004,11 @@ if command -v fake-gcs-server >/dev/null 2>&1 || command -v minio >/dev/null 2>&
         fail "real-backend cloud suite (rc=$rc)" "$rc"
     fi
 else
-    echo "ci_gate: [14/15] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
+    echo "ci_gate: [14/16] real-backend cloud suite skipped (no fake-gcs-server/minio on PATH)"
 fi
 
 # ---- 15. tune smoke ------------------------------------------------------
-echo "ci_gate: [15/15] tune smoke (exit contract: 0 plan / 3 insufficient history; TPUSNAP_AUTOTUNE=1 restore stamps the applied plan)"
+echo "ci_gate: [15/16] tune smoke (exit contract: 0 plan / 3 insufficient history; TPUSNAP_AUTOTUNE=1 restore stamps the applied plan)"
 env JAX_PLATFORMS=cpu python - <<'PYEOF'
 import json, os, shutil, subprocess, sys, tempfile
 
@@ -1074,5 +1090,102 @@ print("tune smoke: OK (exit 3 empty, exit 0 seeded, autotune stamped "
 PYEOF
 rc=$?
 [ "$rc" -eq 0 ] || fail "tune smoke (rc=$rc)" "$rc"
+
+# ---- 16. access-ledger heatmap smoke ------------------------------------
+echo "ci_gate: [16/16] heatmap smoke (exit contract: 3 no ledgers / 0 partial read_object coverage / 2 amplification breach)"
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, shutil, subprocess, sys, tempfile
+
+work = tempfile.mkdtemp(prefix="tpusnap_ci_heatmap_")
+tele = os.path.join(work, "tele")
+snap = os.path.join(work, "snap")
+# Hermetic: ledgers land in the tempdir, never the host's telemetry.
+env = dict(os.environ, JAX_PLATFORMS="cpu", TPUSNAP_TELEMETRY="1",
+           TPUSNAP_TELEMETRY_DIR=tele)
+import atexit
+atexit.register(shutil.rmtree, work, True)
+
+def heatmap(*extra, e=None):
+    return subprocess.run(
+        [sys.executable, "-m", "tpusnap", "heatmap", snap, *extra],
+        capture_output=True, text=True, env=e or env, timeout=120,
+    )
+
+def die(msg):
+    print(f"heatmap smoke: FAIL - {msg}", file=sys.stderr)
+    sys.exit(1)
+
+# (a) A snapshot nobody read: no ledgers -> exit 3.
+take = (
+    "import os; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+    "import numpy as np, sys\n"
+    "from tpusnap import Snapshot, StateDict\n"
+    "s = {'m': StateDict(**{f'w{i}': np.arange(4096 + i, dtype=np.float32)\n"
+    "                       for i in range(8)})}\n"
+    "Snapshot.take(sys.argv[1], s)\n"
+)
+subprocess.run([sys.executable, "-c", take, snap], check=True, env=env,
+               timeout=180)
+r = heatmap("--check")
+if r.returncode != 3:
+    die(f"no ledgers: expected exit 3, got {r.returncode}: "
+        f"{r.stdout[-300:]}{r.stderr[-300:]}")
+
+# (b) One partial reader (read_object of ONE of 8 leaves): coverage
+# must fall below 100% and the read leaf must be the only one with
+# bytes attributed.
+read_one = (
+    "import os; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+    "import sys\n"
+    "from tpusnap import Snapshot\n"
+    "Snapshot(sys.argv[1]).read_object('0/m/w3')\n"
+)
+subprocess.run([sys.executable, "-c", read_one, snap], check=True,
+               env=env, timeout=180)
+r = heatmap("--json")
+if r.returncode != 0:
+    die(f"partial reader: expected exit 0, got {r.returncode}: "
+        f"{r.stderr[-300:]}")
+doc = json.loads(r.stdout)
+if not (0 < doc["coverage"] < 1.0):
+    die(f"partial reader: coverage must be in (0,1), got {doc['coverage']}")
+touched = [l["path"] for l in doc["leaves"] if l["bytes_read"]]
+if touched != ["m/w3"]:
+    die(f"partial reader: only m/w3 may carry bytes, got {touched}")
+partial_cov = doc["coverage"]
+
+# (c) A 3-reader full-restore cohort: merged amplification ~3x must
+# trip a 2.5x --max-amplification gate (exit 2) and pass a 4x one.
+restore = (
+    "import os; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+    "import numpy as np, sys\n"
+    "from tpusnap import Snapshot, StateDict\n"
+    "t = {'m': StateDict(**{f'w{i}': np.zeros(4096 + i, dtype=np.float32)\n"
+    "                       for i in range(8)})}\n"
+    "Snapshot(sys.argv[1]).restore(t)\n"
+)
+for k in range(3):
+    subprocess.run([sys.executable, "-c", restore, snap], check=True,
+                   env=dict(env, TPUSNAP_JOB_ID=f"ci-reader-{k}"),
+                   timeout=180)
+r = heatmap("--json", "--check", "--max-amplification", "2.5")
+if r.returncode != 2:
+    die(f"cohort: expected breach exit 2, got {r.returncode}: "
+        f"{r.stdout[-300:]}{r.stderr[-300:]}")
+doc = json.loads(r.stdout)
+if doc["n_readers"] < 4:  # 3 named readers + the read_object job
+    die(f"cohort: expected >=4 distinct readers, got {doc['n_readers']}")
+if not (doc["coverage"] > 0.99 and doc["amplification"] > 2.5):
+    die(f"cohort: coverage {doc['coverage']} / amplification "
+        f"{doc['amplification']} out of contract")
+r = heatmap("--check", "--max-amplification", "4")
+if r.returncode != 0:
+    die(f"cohort under a 4x budget: expected exit 0, got {r.returncode}")
+print("heatmap smoke: OK (exit 3 no ledgers, partial coverage "
+      f"{partial_cov:.2f} -> only m/w3, cohort amplification "
+      f"{doc['amplification']:.2f}x gated)")
+PYEOF
+rc=$?
+[ "$rc" -eq 0 ] || fail "heatmap smoke (rc=$rc)" "$rc"
 
 echo "ci_gate: PASS"

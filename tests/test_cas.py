@@ -638,7 +638,18 @@ def test_publisher_vs_gc_hammer_zero_lost_blobs(tmp_path):
 
     # Post-hammer: the final refs all resolve through a FRESH plugin
     # (nothing cached), and a final gc converges with zero dangling.
-    gc_store(store, dry_run=False, grace_s=0.5, lease_ttl_s=0.0)
+    # A sweeper terminated mid-sweep leaves its 5s lease live; wait it
+    # out instead of racing the steal window.
+    gc_deadline = time.monotonic() + 15
+    while True:
+        try:
+            gc_store(store, dry_run=False, grace_s=0.5, lease_ttl_s=0.0)
+            break
+        except RuntimeError:
+            assert time.monotonic() < gc_deadline, (
+                "terminated sweeper's gc lease never expired"
+            )
+            time.sleep(0.25)
     refs, _ = read_refs_dir(snap)
     assert len(refs) == 24
     import asyncio

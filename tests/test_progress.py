@@ -486,6 +486,39 @@ def test_back_to_back_restores_keep_run_scoped_traces(tmp_path):
         assert len(run_ids) == len(runs)
 
 
+def test_trace_restore_falls_back_to_run_files(tmp_path, capsys):
+    """A missing or dangling latest-pointer must not hide a rank's
+    traces: ``load_restore_traces`` falls back to the newest run-scoped
+    ``rank_<k>.<run>.json`` (a reaped tmpdir target, a partially-synced
+    telemetry dir)."""
+    from tpusnap.__main__ import main
+    from tpusnap.progress import load_restore_traces, restore_trace_dir
+
+    path = str(tmp_path / "snap")
+    state = {"w": np.arange(4096, dtype=np.float32)}
+    Snapshot.take(path, {"m": PytreeState(state)})
+    with override_telemetry_dir(str(tmp_path / "teledir")):
+        for _ in range(2):
+            Snapshot(path).restore(
+                {"m": PytreeState({"w": np.zeros(4096, np.float32)})}
+            )
+        tdir = restore_trace_dir(path)
+        latest = os.path.join(tdir, "rank_0.json")
+        want_run = load_restore_traces(path)[0]["run_id"]
+        # Dangling symlink: target gone, pointer still there.
+        os.remove(latest)
+        os.symlink("rank_0.feedfeedfeed.json", latest)
+        docs = load_restore_traces(path)
+        assert docs and docs[0]["kind"] == "restore"
+        assert docs[0]["run_id"] == want_run  # newest run file wins
+        # Pointer absent entirely.
+        os.remove(latest)
+        docs = load_restore_traces(path)
+        assert docs and docs[0]["run_id"] == want_run
+        assert main(["trace", path, "--restore"]) == 0
+        assert "restore.read" in capsys.readouterr().out
+
+
 def test_trace_restore_without_traces_exits_3(tmp_path, capsys):
     from tpusnap.__main__ import main
 

@@ -1051,6 +1051,16 @@ def _render_analyze(path: str, report: dict) -> None:
                 f"{probe.get('probes', 0)} probe(s))"
             )
         print(line)
+    acc = report.get("access")
+    if acc and acc.get("bytes_read"):
+        print(
+            f"\naccess: {acc.get('n_readers', 0)} reader(s), "
+            f"{_fmt_bytes(acc.get('bytes_read') or 0)} read over "
+            f"{_fmt_bytes(acc.get('snapshot_bytes') or 0)} stored — "
+            f"coverage {(acc.get('coverage') or 0) * 100:.1f}%, "
+            f"amplification {(acc.get('amplification') or 0):.2f}x "
+            "(`tpusnap heatmap` for the per-leaf view)"
+        )
     trend = report.get("history")
     if trend and trend.get("events"):
         print(f"\nhistory trend (last {trend['events']} {kind} event(s)):")
@@ -1151,12 +1161,27 @@ def cmd_analyze(args) -> int:
     if not rank_docs or not has_spans:
         print(_NO_TELEMETRY_MSG, file=sys.stderr)
         return 3
+    # Access heatmap context (best-effort): when readers left ledgers
+    # for this snapshot, fold coverage/amplification into the report —
+    # the partial_access advice needs both the ledgers and the manifest.
+    heatmap = None
+    try:
+        from . import access
+
+        _recs = access.load_ledger_records(args.path)
+        if _recs:
+            heatmap = access.compute_heatmap(
+                _recs, _heatmap_metadata(args.path)
+            )
+    except Exception:
+        heatmap = None
     report = analyze(
         rollup,
         rank_docs,
         kind=kind,
         thresholds=thresholds,
         history_events=history_events,
+        heatmap=heatmap,
     )
     if args.json:
         print(_json.dumps({"path": args.path, **report}))
@@ -1938,8 +1963,8 @@ def _render_fleet_table(rollup: dict) -> str:
     --fleet``)."""
     lines = [
         f"{'job':<22} {'state':<10} {'phase':<10} {'%':>5} "
-        f"{'since-commit':>13} {'at-risk':>9} {'lag':>9} {'rec-age':>8}"
-        "  flags"
+        f"{'since-commit':>13} {'at-risk':>9} {'lag':>9} {'read':>9} "
+        f"{'rec-age':>8}  flags"
     ]
     for j in rollup.get("jobs") or []:
         flags = []
@@ -1947,6 +1972,8 @@ def _render_fleet_table(rollup: dict) -> str:
             flags.append("DEGRADED")
         if j.get("paused"):
             flags.append("PAUSED")
+        if j.get("reader"):
+            flags.append("READER")
         if j.get("dead_ranks"):
             flags.append(
                 "dead:" + ",".join(str(r) for r in j["dead_ranks"])
@@ -1959,6 +1986,7 @@ def _render_fleet_table(rollup: dict) -> str:
             f"{_fmt_age(j.get('rpo_s') or 0):>13} "
             f"{_fmt_bytes(j.get('data_at_risk_bytes') or 0):>9} "
             f"{_fmt_bytes(j.get('lag_bytes') or 0):>9} "
+            f"{(_fmt_bytes(j['bytes_read']) if j.get('bytes_read') else '-'):>9} "
             f"{_fmt_age(j.get('age_s') or 0):>8}  "
             f"{' '.join(flags) or '-'}"
         )
@@ -1985,6 +2013,18 @@ def _fleet_summary_lines(rollup: dict) -> str:
         f"upload lag {_fmt_bytes(rollup.get('lag_bytes_total') or 0)} "
         f"(oldest {_fmt_age(rollup.get('lag_seconds_max') or 0)})"
     )
+    if rollup.get("readers"):
+        amp = rollup.get("read_amplification")
+        line = (
+            f"{rollup['readers']} reader(s), "
+            f"{_fmt_bytes(rollup.get('bytes_read_total') or 0)} read"
+        )
+        if amp is not None:
+            line += (
+                f", worst read amplification {amp:.2f}x "
+                f"(snapshot {rollup.get('read_amplification_digest')})"
+            )
+        parts.append(line)
     w = (rollup.get("storage") or {}).get("write") or {}
     if w.get("count"):
         parts.append(
@@ -2022,6 +2062,7 @@ def cmd_fleet(args) -> int:
         lag_bytes_threshold=args.lag_bytes,
         lag_seconds_threshold=args.lag_s,
         p99_ratio_threshold=args.p99_ratio,
+        max_read_amplification=args.max_read_amplification,
     )
     if args.prom_out:
         write_fleet_prom(rollup, args.prom_out)
@@ -2035,7 +2076,8 @@ def cmd_fleet(args) -> int:
             f"rpo={'%gs' % th['rpo_s'] if th['rpo_s'] else 'unset'} "
             f"lag_bytes={th['lag_bytes'] or 'unset'} "
             f"lag_s={'%gs' % th['lag_seconds'] if th['lag_seconds'] else 'unset'} "
-            f"p99_ratio={'%gx' % th['p99_ratio'] if th['p99_ratio'] else 'unset'}"
+            f"p99_ratio={'%gx' % th['p99_ratio'] if th['p99_ratio'] else 'unset'} "
+            f"read_amp={'%gx' % th['read_amplification'] if th['read_amplification'] else 'unset'}"
         )
         if records:
             print()
@@ -2100,6 +2142,110 @@ def _watch_fleet(args) -> int:
         if deadline is not None and time.monotonic() > deadline:
             return 0 if seen_records else 3
         time.sleep(args.interval)
+
+
+def _heatmap_metadata(path: str):
+    """Own-resources manifest read for the heatmap CLI (the
+    verify_snapshot pattern: fresh loop + plugin, closed on exit)."""
+    import asyncio
+
+    from .inspect import _read_metadata
+    from .storage_plugin import url_to_storage_plugin_in_event_loop
+
+    event_loop = asyncio.new_event_loop()
+    try:
+        storage = url_to_storage_plugin_in_event_loop(path, event_loop, None)
+        try:
+            return _read_metadata(storage, event_loop, path)
+        finally:
+            storage.sync_close(event_loop)
+    finally:
+        event_loop.close()
+
+
+def cmd_heatmap(args) -> int:
+    import json as _json
+
+    from . import access
+
+    records = access.load_ledger_records(args.path)
+    if not records:
+        print(
+            f"no access ledgers for {args.path} under "
+            f"{access.access_dir(args.path)} — readers record only with "
+            "TPUSNAP_TELEMETRY=1 (and TPUSNAP_ACCESS_LEDGER not 0)",
+            file=sys.stderr,
+        )
+        return 3
+    metadata = _heatmap_metadata(args.path)
+    hm = access.compute_heatmap(records, metadata)
+    breach = bool(
+        args.max_amplification is not None
+        and hm["amplification"] > args.max_amplification
+    )
+    if args.json:
+        out = {"path": args.path, **hm}
+        if args.max_amplification is not None:
+            out["max_amplification"] = args.max_amplification
+            out["breach"] = breach
+        print(_json.dumps(out))
+    else:
+        print(f"snapshot:   {args.path}")
+        print(f"ledgers:    {access.access_dir(args.path)}")
+        print(
+            f"readers:    {hm['n_readers']}  "
+            f"(bytes read {_fmt_bytes(hm['bytes_read'])} over "
+            f"{_fmt_bytes(hm['snapshot_bytes'])} stored)"
+        )
+        print(
+            f"coverage:   {hm['coverage'] * 100:.1f}% of stored bytes "
+            "ever read"
+        )
+        amp_line = f"amplification: {hm['amplification']:.2f}x"
+        if args.max_amplification is not None:
+            amp_line += (
+                f"  (threshold {args.max_amplification:g}x — "
+                + ("BREACH" if breach else "ok")
+                + ")"
+            )
+        print(amp_line)
+        if hm.get("unattributed_bytes"):
+            print(
+                f"unattributed: {_fmt_bytes(hm['unattributed_bytes'])} "
+                "(ledger paths absent from this manifest — stale "
+                "ledgers or a rewritten snapshot)"
+            )
+        print()
+        print(
+            f"{'leaf':<44} {'stored':>9} {'read':>9} {'reads':>6} "
+            f"{'rdrs':>5} {'cov%':>6} {'amp':>6}  sources"
+        )
+        for row in hm["leaves"]:
+            srcs = ",".join(
+                f"{s}:{_fmt_bytes(b)}"
+                for s, b in sorted(row["sources"].items())
+            )
+            print(
+                f"{row['path'][:44]:<44} "
+                f"{_fmt_bytes(row['stored_bytes']):>9} "
+                f"{_fmt_bytes(row['bytes_read']):>9} "
+                f"{row['reads']:>6} {row['readers']:>5} "
+                f"{row['coverage'] * 100:>5.1f}% "
+                f"{row['amplification']:>5.2f}x  {srcs or '-'}"
+            )
+        hot = hm["hot_ranges"][: args.top]
+        if hot:
+            print()
+            print(f"hottest tile ranges (top {len(hot)}):")
+            for h in hot:
+                print(
+                    f"  {h['path']}  {h['location']}"
+                    f"[{h['range'][0]}:{h['range'][1]})  "
+                    f"{h['reads']} read(s), {_fmt_bytes(h['bytes'])}"
+                )
+    if args.check and breach:
+        return 2
+    return 0
 
 
 def cmd_cat(args) -> int:
@@ -2571,11 +2717,45 @@ def main(argv=None) -> int:
         "--json", action="store_true", help="machine-readable report"
     )
     p.add_argument(
+        "--max-read-amplification", type=float, default=None, metavar="X",
+        dest="max_read_amplification",
+        help="breach when any snapshot's merged cross-reader read "
+        "amplification (aggregate bytes read / stored bytes) exceeds X "
+        "(default: no objective)",
+    )
+    p.add_argument(
         "--check", action="store_true",
         help="gate mode: exit 2 on a breached fleet objective, 3 when "
         "no status records exist, 0 healthy",
     )
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser(
+        "heatmap",
+        help="merge reader access ledgers into a per-leaf read heatmap "
+        "— counts, bytes, distinct readers, coverage and read "
+        "amplification (requires readers run with TPUSNAP_TELEMETRY=1)",
+    )
+    p.add_argument("path", help="snapshot path the ledgers were recorded for")
+    p.add_argument(
+        "--top", type=int, default=10, metavar="N",
+        help="hottest tile ranges to list (default 10)",
+    )
+    p.add_argument(
+        "--max-amplification", type=float, default=None, metavar="X",
+        dest="max_amplification",
+        help="flag (and with --check, gate) aggregate read "
+        "amplification above X (bytes read / stored bytes)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable heatmap"
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="gate mode: exit 2 when amplification exceeds "
+        "--max-amplification, 3 when no ledgers exist, 0 otherwise",
+    )
+    p.set_defaults(fn=cmd_heatmap)
 
     p = sub.add_parser(
         "lint",

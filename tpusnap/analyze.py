@@ -360,6 +360,9 @@ class Thresholds:
     min_read_roofline: float = 0.4  # restore_roofline_fraction gate
     max_skew: float = 2.0  # per-phase straggler skew beyond this → warn
     min_coverage: float = 0.5  # attribution coverage below this → info
+    # Access-ledger coverage (bytes ever read ÷ stored) below this →
+    # the fleet reads a sliver of the snapshot; advise the lazy path.
+    min_access_coverage: float = 0.3
 
 
 def tail_latency_findings(
@@ -464,6 +467,40 @@ def roofline_findings(
     return out
 
 
+def access_findings(
+    heatmap: Dict[str, Any], thresholds: Thresholds
+) -> List[Finding]:
+    """Serving advice from the merged access heatmap (see
+    :func:`tpusnap.access.compute_heatmap`). ``info`` severity: partial
+    access is an optimization opportunity, not a failure — the gateable
+    side lives in ``heatmap --check`` / ``fleet --check``."""
+    out: List[Finding] = []
+    cov = (heatmap or {}).get("coverage")
+    if not (heatmap or {}).get("bytes_read"):
+        return out
+    if (
+        isinstance(cov, (int, float))
+        and cov < thresholds.min_access_coverage
+    ):
+        hot = ", ".join(
+            f"{h['path']}[{h['range'][0]}:{h['range'][1]})"
+            for h in (heatmap.get("hot_ranges") or [])[:5]
+        )
+        out.append(
+            Finding(
+                "info",
+                "partial_access",
+                f"{heatmap.get('n_readers', 0)} reader(s) ever touched "
+                f"only {cov:.0%} of this snapshot's stored bytes — "
+                "serve it through read_object / the lazy path instead "
+                "of full restores, and keep just the hot tiles on the "
+                "fast tier"
+                + (f"; hottest: {hot}" if hot else ""),
+            )
+        )
+    return out
+
+
 # ---------------------------------------------------------- the report
 
 
@@ -473,6 +510,7 @@ def analyze(
     kind: str = "take",
     thresholds: Optional[Thresholds] = None,
     history_events: Optional[List[Dict[str, Any]]] = None,
+    heatmap: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The doctor report: bound verdict + attribution for the SLOWEST
     traced rank (the take ends when it does), per-rank attributions,
@@ -551,6 +589,19 @@ def analyze(
         if roofline_src.get("probe"):
             report["probe"] = roofline_src["probe"]
         findings.extend(roofline_findings(roofline_src, thresholds))
+
+    if heatmap:
+        report["access"] = {
+            k: heatmap.get(k)
+            for k in (
+                "snapshot_bytes",
+                "bytes_read",
+                "coverage",
+                "amplification",
+                "n_readers",
+            )
+        }
+        findings.extend(access_findings(heatmap, thresholds))
 
     if history_events:
         report["history"] = history_context(history_events, kind)

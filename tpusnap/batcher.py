@@ -488,6 +488,15 @@ def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
                     span_start,
                     [(tuple(r.byte_range), r.buffer_consumer) for r in reqs],
                 ),
+                # Per-member attribution survives the merge: the access
+                # ledger records each member's own leaf and range, not
+                # the opaque spanning read.
+                access_parts=[
+                    (r.logical_path, r.byte_range[0], r.byte_range[1])
+                    for r in reqs
+                    if r.logical_path
+                ]
+                or None,
             )
         )
     return out

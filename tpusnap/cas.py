@@ -668,6 +668,9 @@ class CASStoragePlugin(StoragePlugin):
             if rec is not None:
                 await self.store().read_blob(blob_key(tuple(rec)), read_io)
                 telemetry.incr("cas.store_reads")
+                # Access-ledger provenance: a ref-translated store read
+                # (the logical location has no private copy).
+                read_io.source = "cas"
                 return
         await self.inner.read(read_io)
 

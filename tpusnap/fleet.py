@@ -163,6 +163,13 @@ class FleetPublisher:
                 rec["io_histograms"] = hists
         except Exception:
             logger.debug("fleet histogram snapshot failed", exc_info=True)
+        rs = reader_stats_snapshot()
+        if rs:
+            rec["reader"] = {
+                "bytes_read": sum(s["bytes_read"] for s in rs.values()),
+                "reads": sum(s["reads"] for s in rs.values()),
+                "snapshots": rs,
+            }
         if final:
             rec["final"] = True
         return rec
@@ -283,6 +290,67 @@ def _finalize_on_exit() -> None:
         p.publish(final=True)
 
 
+# ---------------------------------------------------- reader attribution
+#
+# Reader jobs (restore / read_object consumers) have no heartbeat pump
+# to ride — their fleet presence is published directly at access-ledger
+# scope exit. Stats accumulate per snapshot DIGEST so the fold can
+# merge amplification across readers of the same snapshot.
+
+_reader_lock = threading.Lock()
+_reader_stats: Dict[str, Dict[str, Any]] = {}
+
+
+def note_reader_scope(
+    snapshot_digest: str,
+    snapshot_bytes: int,
+    bytes_read: int,
+    reads: int,
+) -> None:
+    """Fold one finished read scope into this process's reader stats
+    and republish the job's fleet record. No-op when the fleet layer is
+    off; never raises (observability stance)."""
+    try:
+        p = publisher()
+        if p is None:
+            return
+        with _reader_lock:
+            st = _reader_stats.setdefault(
+                snapshot_digest,
+                {
+                    "snapshot_bytes": 0,
+                    "bytes_read": 0,
+                    "reads": 0,
+                    "scopes": 0,
+                },
+            )
+            st["snapshot_bytes"] = max(
+                int(st["snapshot_bytes"]), int(snapshot_bytes or 0)
+            )
+            st["bytes_read"] += int(bytes_read)
+            st["reads"] += int(reads)
+            st["scopes"] += 1
+        _arm_atexit_finalizer()
+        p.publish()
+    except Exception:
+        logger.debug("fleet reader publish failed", exc_info=True)
+
+
+def reader_stats_snapshot() -> Optional[Dict[str, Any]]:
+    """This process's per-digest reader stats, or None when it never
+    read through an access-ledger scope."""
+    with _reader_lock:
+        if not _reader_stats:
+            return None
+        return {d: dict(s) for d, s in _reader_stats.items()}
+
+
+def reset_reader_stats() -> None:
+    """Test aid; production code never resets."""
+    with _reader_lock:
+        _reader_stats.clear()
+
+
 # --------------------------------------------------------------- reading
 
 
@@ -345,11 +413,14 @@ def fold_fleet(
             and cadence > 0
             and rpo > stream_x * cadence
         )
+        reader = rec.get("reader") or {}
         jobs.append(
             {
                 "job_id": rec.get("job_id"),
                 "state": "finished" if final else rec.get("state") or "unknown",
                 "final": final,
+                "reader": bool(reader),
+                "bytes_read": int(reader.get("bytes_read") or 0),
                 "ts": ts,
                 "age_s": round(age, 2),
                 "rank": rec.get("rank", 0),
@@ -392,6 +463,29 @@ def fold_fleet(
             logger.debug("fleet histogram fold failed", exc_info=True)
     worst = max(jobs, key=lambda j: j["rpo_s"], default=None)
     worst_risk = max(jobs, key=lambda j: j["data_at_risk_bytes"], default=None)
+    # Read-side fold: amplification merges ACROSS readers of the same
+    # snapshot (aggregate bytes read / stored bytes), keyed by the
+    # snapshot-path digest the ledger scope stamped. A single reader at
+    # 1.0x is healthy; ten full restores of one snapshot is 10x on the
+    # serving substrate — only the cross-reader sum sees that.
+    digest_reads: Dict[str, Dict[str, int]] = {}
+    for rec in records:
+        for digest, st in ((rec.get("reader") or {}).get("snapshots") or {}).items():
+            acc = digest_reads.setdefault(
+                digest, {"snapshot_bytes": 0, "bytes_read": 0}
+            )
+            acc["snapshot_bytes"] = max(
+                acc["snapshot_bytes"], int(st.get("snapshot_bytes") or 0)
+            )
+            acc["bytes_read"] += int(st.get("bytes_read") or 0)
+    read_amp = None
+    read_amp_digest = None
+    for digest, acc in digest_reads.items():
+        if acc["snapshot_bytes"] <= 0:
+            continue
+        amp = round(acc["bytes_read"] / acc["snapshot_bytes"], 4)
+        if read_amp is None or amp > read_amp:
+            read_amp, read_amp_digest = amp, digest
     return {
         "v": 1,
         "ts": now,
@@ -410,6 +504,10 @@ def fold_fleet(
         "worst_at_risk_job": worst_risk["job_id"] if worst_risk else None,
         "lag_bytes_total": sum(j["lag_bytes"] for j in jobs),
         "lag_seconds_max": max((j["lag_seconds"] for j in jobs), default=0.0),
+        "readers": sum(1 for j in jobs if j["reader"]),
+        "bytes_read_total": sum(j["bytes_read"] for j in jobs),
+        "read_amplification": read_amp,
+        "read_amplification_digest": read_amp_digest,
         "storage": storage,
         "io_histograms": merged or None,
         "jobs": jobs,
@@ -426,6 +524,7 @@ def evaluate_fleet(
     lag_seconds_threshold: Optional[float] = None,
     p99_ratio_threshold: Optional[float] = None,
     min_latency_samples: int = 20,
+    max_read_amplification: Optional[float] = None,
 ) -> Dict[str, Any]:
     """The ``fleet --check`` verdict over a rollup: ``breach`` when any
     configured fleet objective is crossed — worst-job RPO, aggregate
@@ -442,6 +541,7 @@ def evaluate_fleet(
         "lag_bytes": lag_bytes_threshold,
         "lag_seconds": lag_seconds_threshold,
         "p99_ratio": p99_ratio_threshold,
+        "read_amplification": max_read_amplification,
     }
     if not rollup.get("n_jobs"):
         return {
@@ -494,6 +594,18 @@ def evaluate_fleet(
             ratio = round(p99 / p50, 2)
             check("storage_write_p99_ratio", ratio, p99_ratio_threshold,
                   ratio > p99_ratio_threshold)
+    if max_read_amplification:
+        # Skipped when no reader attributed any bytes — absence of
+        # readers is not a serving breach.
+        amp = rollup.get("read_amplification")
+        if amp is not None:
+            check(
+                "read_amplification",
+                amp,
+                max_read_amplification,
+                amp > max_read_amplification,
+                job=rollup.get("read_amplification_digest"),
+            )
     breached = [c for c in checks if c["breach"]]
     if breached:
         c = breached[0]
@@ -580,6 +692,23 @@ def render_fleet_prom(rollup: Dict[str, Any]) -> str:
             [(
                 {"job": str(rollup.get("worst_at_risk_job"))},
                 rollup["worst_data_at_risk_bytes"],
+            )],
+        )
+    metric(
+        "tpusnap_fleet_readers",
+        "gauge",
+        "Jobs that attributed snapshot reads through the access ledger.",
+        [({}, rollup.get("readers") or 0)],
+    )
+    if rollup.get("read_amplification") is not None:
+        metric(
+            "tpusnap_fleet_read_amplification",
+            "gauge",
+            "Worst-snapshot aggregate bytes read across all readers "
+            "over the snapshot's stored bytes.",
+            [(
+                {"digest": str(rollup.get("read_amplification_digest"))},
+                rollup["read_amplification"],
             )],
         )
     metric(

@@ -206,6 +206,16 @@ def event_from_summary(kind: str, summary: Dict[str, Any]) -> Dict[str, Any]:
         pr = (summary.get("probe") or {}).get("read_gbps_p50")
         if pr:
             ev["probe_read_gbps"] = pr
+    # Access-ledger attribution (restores / read_object scopes): the
+    # scope's logical read totals and distinct-byte working set. Flat
+    # ints so `analyze`/`tune` can size restore budgets from the HOT
+    # working set instead of the whole snapshot, and so amplification
+    # trends are greppable straight from history.jsonl.
+    acc = summary.get("access")
+    if isinstance(acc, dict):
+        ev["access_bytes_read"] = int(acc.get("bytes_read") or 0)
+        ev["access_reads"] = int(acc.get("reads") or 0)
+        ev["access_working_set_bytes"] = int(acc.get("working_set_bytes") or 0)
     # Auto-tuner provenance (TPUSNAP_AUTOTUNE=1): which plan and which
     # knobs this run actually applied, so any regression the tuner
     # causes is attributable — and gated by the same `history --check`

@@ -1198,6 +1198,7 @@ class ArrayIOPreparer:
                 buffer_consumer=consumer,
                 into=consumer.into_mv,
                 want_crc=consumer.into_mv is not None and _want_crc(entry),
+                logical_path=logical_path,
             )
         ]
         return read_reqs, fut
@@ -1294,6 +1295,7 @@ class ArrayIOPreparer:
                     into=consumer.into_mv,
                     want_crc=consumer.into_mv is not None
                     and tile_checksum is not None,
+                    logical_path=logical_path,
                 )
             )
         return read_reqs, fut
@@ -1416,6 +1418,7 @@ class ArrayIOPreparer:
                     byte_range=(comp_start, comp_end),
                     buffer_consumer=consumer,
                     want_crc=expected is not None,
+                    logical_path=logical_path,
                 )
             )
         return read_reqs, fut

@@ -216,6 +216,7 @@ class ChunkedArrayIOPreparer:
                     into=consumer.into_mv,
                     want_crc=consumer.into_mv is not None
                     and _want_crc(tensor_entry),
+                    logical_path=logical_path,
                 )
             )
         return read_reqs, fut
@@ -277,6 +278,7 @@ def _compressed_chunk_read_req(
         byte_range=(0, sum(sizes)),
         buffer_consumer=consumer,
         want_crc=expected is not None,
+        logical_path=logical_path,
     )
 
 

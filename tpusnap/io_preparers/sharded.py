@@ -250,6 +250,7 @@ class ShardedArrayIOPreparer:
                     # (fused on the read thread); the consumer verifies
                     # the value without re-reading the buffer.
                     want_crc=_want_crc(saved.tensor),
+                    logical_path=logical_path,
                 )
             )
         assembler.total_reads = len(read_reqs)

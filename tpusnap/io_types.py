@@ -96,6 +96,13 @@ class ReadIO:
     in_place: bool = False
     crc32c: Optional[int] = None
     crc_algo: Optional[str] = None
+    # Access-ledger provenance: plugins that redirect the read away from
+    # the plain local path stamp where the bytes actually came from
+    # ("cas" for a ref-translated store read, "evicted-read-through" for
+    # a tiered local miss served by the remote). Left None for ordinary
+    # reads; the scheduler's recorder then attributes the read to the
+    # ambient storage tier (local/remote).
+    source: Optional[str] = None
 
 
 class _SkipWrite:
@@ -171,6 +178,17 @@ class ReadReq:
     # read-time checksum of the delivered bytes.
     into: Optional[memoryview] = None
     want_crc: bool = False
+    # Access-ledger attribution: the MANIFEST path this physical read
+    # serves ("<rank>/<logical_path>" — the storage ``path`` is a blob
+    # location, shared across leaves and meaningless to a reader).
+    # Empty string = unattributed (manifest/metadata traffic).
+    logical_path: str = ""
+    # When the batcher merges several byte-ranged requests on one
+    # location into a single spanning read, per-member attribution
+    # survives here: [(logical_path, start, end), ...] in storage-blob
+    # coordinates. None = the read serves exactly ``logical_path``
+    # over ``byte_range``.
+    access_parts: Optional[List[Tuple[str, int, int]]] = None
 
 
 class StoragePlugin(abc.ABC):

@@ -72,6 +72,8 @@ _CAS_DIR_ENV_VAR = "TPUSNAP_CAS_DIR"
 _CAS_GRACE_ENV_VAR = "TPUSNAP_CAS_GRACE_S"
 _CAS_LEASE_TTL_ENV_VAR = "TPUSNAP_CAS_LEASE_TTL_S"
 _CAS_REMOTE_ENV_VAR = "TPUSNAP_CAS_REMOTE"
+_ACCESS_LEDGER_ENV_VAR = "TPUSNAP_ACCESS_LEDGER"
+_ACCESS_LEDGER_MAX_BYTES_ENV_VAR = "TPUSNAP_ACCESS_LEDGER_MAX_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -397,6 +399,36 @@ def get_history_max_bytes() -> int:
     every append."""
     return max(
         64 * 1024, _get_int_env(_HISTORY_MAX_BYTES_ENV_VAR, 4 * 1024 * 1024)
+    )
+
+
+def is_access_ledger_enabled() -> bool:
+    """Read-side access attribution (:mod:`tpusnap.access`): every
+    restore / ``read_object`` records which manifest leaves and byte
+    ranges it actually read, aggregated in memory and appended as one
+    JSONL summary line per read scope to the per-reader ledger sidecar
+    (``TPUSNAP_TELEMETRY_DIR/access/<digest>/<job_id>.jsonl``) that
+    ``tpusnap heatmap`` and the fleet fold merge across readers. On by
+    default — the per-read cost is one dict update on an already-
+    telemetry-instrumented path, bounded by the tier-1 ≤10% overhead
+    guard. ``TPUSNAP_ACCESS_LEDGER=0`` disables recording (no ledger
+    file is ever written); also off whenever telemetry as a whole is
+    disabled."""
+    return (
+        os.environ.get(_ACCESS_LEDGER_ENV_VAR, "1") != "0"
+        and is_telemetry_enabled()
+    )
+
+
+def get_access_ledger_max_bytes() -> int:
+    """Size bound on one reader's access ledger file: when an append
+    pushes it past this, the file rotates to ``<name>.1`` (previous
+    rotation overwritten) — same single-generation scheme as the JSONL
+    metrics sink. Floor of 64 KiB so a misconfigured bound cannot
+    rotate on every flush."""
+    return max(
+        64 * 1024,
+        _get_int_env(_ACCESS_LEDGER_MAX_BYTES_ENV_VAR, 8 * 1024 * 1024),
     )
 
 
@@ -1036,6 +1068,18 @@ def override_history_enabled(enabled: bool) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_history_max_bytes(nbytes: int) -> Generator[None, None, None]:
     with _override_env(_HISTORY_MAX_BYTES_ENV_VAR, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_access_ledger(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(_ACCESS_LEDGER_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_access_ledger_max_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_ACCESS_LEDGER_MAX_BYTES_ENV_VAR, str(nbytes)):
         yield
 
 

@@ -1154,6 +1154,30 @@ def gc_snapshot(
             ordered = sorted(
                 targets, key=lambda p: (p == JOURNAL_FNAME, p)
             )
+            if evict_local and targets:
+                # Cold-first eviction: blobs no reader ever touched go
+                # before the fleet's hot tiles, so an interrupted
+                # eviction leaves the popular working set on the fast
+                # tier. Popularity comes from the access ledgers (the
+                # tier URL and its local dir digest identically); no
+                # ledgers → plain name order, same as before.
+                try:
+                    from . import access
+
+                    counts = access.location_read_counts(
+                        access.load_ledger_records(path)
+                    )
+                except Exception:
+                    counts = {}
+                if counts:
+                    ordered = sorted(
+                        targets,
+                        key=lambda p: (
+                            p == JOURNAL_FNAME,
+                            counts.get(p, 0),
+                            p,
+                        ),
+                    )
             done: Dict[str, int] = {}
             for p in ordered:
                 if (

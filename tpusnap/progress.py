@@ -616,6 +616,7 @@ def restore_trace_dir(snapshot_path: str) -> str:
 RESTORE_TRACE_KEEP = 8
 
 _RANK_LATEST_RE = re.compile(r"^rank_(\d+)\.json$")
+_RANK_RUN_RE = re.compile(r"^rank_(\d+)\.[0-9a-f]+\.json$")
 
 
 def persist_restore_trace(tele, snapshot_path: str) -> str:
@@ -695,15 +696,23 @@ def load_restore_traces(snapshot_path: str) -> Dict[int, Dict[str, Any]]:
     """Per-rank restore trace docs persisted on THIS machine for
     ``snapshot_path`` (restore issues no collectives, so there is no
     cross-host gather — each host holds its own ranks' traces). Reads
-    each rank's ``rank_<k>.json`` latest pointer — run-scoped files
-    from older restores are retained on disk but not returned."""
+    each rank's ``rank_<k>.json`` latest pointer; when that pointer is
+    missing or dangling (a reaped target, a partially-synced dir, an
+    older build that never wrote one) the rank falls back to its NEWEST
+    run-scoped ``rank_<k>.<run>.json`` by mtime instead of silently
+    dropping out of the report."""
     tdir = restore_trace_dir(snapshot_path)
     out: Dict[int, Dict[str, Any]] = {}
     try:
         names = os.listdir(tdir)
     except OSError:
         return out
+    run_files: Dict[int, List[str]] = {}
     for name in sorted(names):
+        m = _RANK_RUN_RE.match(name)
+        if m:
+            run_files.setdefault(int(m.group(1)), []).append(name)
+            continue
         if not _RANK_LATEST_RE.match(name):
             continue
         try:
@@ -712,6 +721,25 @@ def load_restore_traces(snapshot_path: str) -> Dict[int, Dict[str, Any]]:
             out[int(doc["rank"])] = doc
         except Exception:
             continue
+    for rank, runs in run_files.items():
+        if rank in out:
+            continue
+        dated = []
+        for name in runs:
+            try:
+                dated.append(
+                    (os.stat(os.path.join(tdir, name)).st_mtime, name)
+                )
+            except OSError:
+                continue
+        for _, name in sorted(dated, reverse=True):
+            try:
+                with open(os.path.join(tdir, name), "r") as f:
+                    doc = json.load(f)
+                out[int(doc["rank"])] = doc
+                break
+            except Exception:
+                continue
     return out
 
 

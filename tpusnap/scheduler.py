@@ -48,7 +48,7 @@ from typing import Callable, List, Optional, Set
 
 import psutil
 
-from . import flight, telemetry
+from . import access, flight, telemetry
 from .io_types import (
     PROBE_DIR,
     ReadIO,
@@ -1066,10 +1066,12 @@ class _ReadPipeline:
         read_req: ReadReq,
         storage: StoragePlugin,
         tele: Optional[telemetry.TakeTelemetry] = None,
+        ledger: Optional[access.AccessLedger] = None,
     ) -> None:
         self.read_req = read_req
         self.storage = storage
         self.tele = tele
+        self.ledger = ledger
         # In-place reads allocate no full-size scratch buffer (bytes land
         # in the caller-owned restore target), so they are charged only
         # the plugin's transient overhead — the fs engine's per-stream
@@ -1123,7 +1125,31 @@ class _ReadPipeline:
             )
         telemetry.incr("storage.bytes_read", nbytes, rec=self.tele)
         telemetry.incr("storage.reads", rec=self.tele)
+        self._record_access(nbytes)
         return self
+
+    def _record_access(self, nbytes: int) -> None:
+        """Attribute this physical read to the manifest leaf (or, for a
+        batcher-merged spanning read, each member leaf) in the ambient
+        access ledger. Plugins that redirected the read stamped the
+        source tier on the ReadIO."""
+        ledger = self.ledger
+        if ledger is None:
+            return
+        rr = self.read_req
+        source = self.read_io.source if self.read_io is not None else None
+        if rr.access_parts:
+            for lp, start, end in rr.access_parts:
+                ledger.record(
+                    lp, rr.path, start, end, end - start, source
+                )
+            return
+        if not rr.logical_path:
+            return
+        start, end = rr.byte_range if rr.byte_range else (0, nbytes)
+        ledger.record(
+            rr.logical_path, rr.path, start, end, nbytes, source
+        )
 
     async def consume(self, executor: ThreadPoolExecutor) -> "_ReadPipeline":
         # "consume" covers deserialize + the copy/`device_put` into the
@@ -1163,9 +1189,12 @@ async def execute_read_reqs(
     # None for uninstrumented callers (verify's own engine, read_object
     # outside a recorder) — spans then skip, counters stay global.
     tele = telemetry.current()
+    # Ambient access ledger (same pattern): installed by the restore /
+    # read_object scopes; None means attribution is off for this call.
+    ledger = access.current()
     pipelines = deque(
         sorted(
-            (_ReadPipeline(rr, storage, tele) for rr in read_reqs),
+            (_ReadPipeline(rr, storage, tele, ledger) for rr in read_reqs),
             key=lambda p: p.consuming_cost,
             reverse=True,
         )

@@ -48,7 +48,7 @@ from typing import Any, Dict, List, Optional, Set
 
 import jax
 
-from . import telemetry
+from . import access, telemetry
 from .comm import Communicator, get_communicator
 from .dist_store import (
     CoordinationKVStore,
@@ -643,15 +643,34 @@ class Snapshot:
         tele = telemetry.begin_restore(comm.rank)
         tele.meta.update(path=self.path, world_size=comm.world_size)
         mark = telemetry.PhaseMarker(rec=tele, from_start=True)
+        # Access-ledger scope around the whole read path: every ReadReq
+        # the restore executes attributes (logical path, byte range,
+        # source tier) to this reader's sidecar — the raw material for
+        # `tpusnap heatmap`. Opened manually (not read_scope) so the
+        # disk flush waits until the telemetry wall has closed below;
+        # otherwise it reads as unspanned restore time on tiny loads.
+        ledger = access.open_ledger(
+            self.path, default_source=self._access_default_source()
+        )
         try:
             with telemetry.use(tele):
-                self._restore_instrumented(
-                    app_state, comm, per_key_barrier, memory_budget, mark
-                )
+                with access.use(ledger):
+                    self._restore_instrumented(
+                        app_state, comm, per_key_barrier, memory_budget, mark
+                    )
             # Only a restore that ran to completion becomes a history
             # trend point; the summary itself still publishes either way.
             tele.meta["completed"] = True
         finally:
+            if ledger is not None:
+                # In-memory totals only: the summary needs the access_*
+                # fields, but the flush and fleet publish happen after
+                # finalize() so they stay outside the measured wall.
+                tele.meta["access"] = {
+                    "bytes_read": ledger.total_bytes,
+                    "reads": ledger.total_reads,
+                    "working_set_bytes": ledger.working_set_bytes(),
+                }
             # The tuned overlay is scoped to the operation that applied
             # it — knob reads after the restore see the plain env again.
             from .knobs import clear_tuned_plan
@@ -670,6 +689,14 @@ class Snapshot:
                         "Failed to persist restore trace (non-fatal)",
                         exc_info=True,
                     )
+            if ledger is not None:
+                try:
+                    ledger.flush()
+                except Exception:
+                    logger.debug(
+                        "access ledger flush failed", exc_info=True
+                    )
+                self._publish_access_stats(ledger)
 
     def _restore_instrumented(
         self, app_state, comm, per_key_barrier, memory_budget, mark
@@ -769,8 +796,50 @@ class Snapshot:
             logical_path=logical_path,
         )
         budget = memory_budget_bytes or get_process_memory_budget_bytes(comm)
-        sync_execute_read_reqs(read_reqs, storage, budget, comm.rank, event_loop)
+        # Random access is the lazy-serving path the heatmap exists to
+        # credit: scope just this object's reads so partial readers show
+        # up with coverage << 1 instead of vanishing.
+        with access.read_scope(
+            self.path, default_source=self._access_default_source()
+        ) as ledger:
+            sync_execute_read_reqs(
+                read_reqs, storage, budget, comm.rank, event_loop
+            )
+        if ledger is not None:
+            self._publish_access_stats(ledger)
         return fut.obj
+
+    def _access_default_source(self) -> str:
+        """Ambient source tier for access-ledger records whose ReadIO
+        carries no explicit stamp (tiering/CAS override per read)."""
+        try:
+            from .storage_plugin import storage_plugin_label
+
+            _, storage = self._resources()
+            return access.default_source_for_plugin(storage_plugin_label(storage))
+        except Exception:
+            return "local"
+
+    def _publish_access_stats(self, ledger) -> None:
+        """Fold a finished read scope's totals into this process's fleet
+        reader record (the reader side of `tpusnap fleet`). The restore
+        path stamps its telemetry meta separately, inside the wall.
+        Best-effort: attribution never fails a read."""
+        try:
+            from . import fleet
+            from .progress import _path_digest
+
+            snapshot_bytes = 0
+            if self._metadata is not None:
+                snapshot_bytes = access.snapshot_stored_nbytes(self._metadata)
+            fleet.note_reader_scope(
+                _path_digest(self.path),
+                snapshot_bytes,
+                ledger.total_bytes,
+                ledger.total_reads,
+            )
+        except Exception:
+            logger.debug("fleet reader stats publish failed", exc_info=True)
 
     # ------------------------------------------------------------- integrity
 

@@ -440,6 +440,9 @@ class TieredStoragePlugin(StoragePlugin):
         read_io.in_place = trial.in_place
         read_io.crc32c = trial.crc32c
         read_io.crc_algo = trial.crc_algo
+        # Access-ledger provenance: the bytes came through the remote
+        # tier because the local copy was evicted (or never landed).
+        read_io.source = "evicted-read-through"
 
     async def delete(self, path: str) -> None:
         if path.startswith(SIDECAR_PREFIX):

@@ -45,6 +45,7 @@ _MIN_ASYNC_WINDOW_BYTES = 256 * 1024 * 1024
 _MAX_STAGE_THREADS = 8
 _PROBE_INTERVAL_FLOOR = 16 * 1024 * 1024
 _PROBE_INTERVAL_CAP = 2 * 1024 * 1024 * 1024
+_MIN_RESTORE_BUDGET_BYTES = 16 * 1024 * 1024
 
 
 @dataclass
@@ -305,6 +306,46 @@ def build_plan(
                             "median budget high-water "
                             f"({int(med_hw)}→{target} bytes) so more "
                             "tiled reads stay in flight"
+                        ),
+                    )
+                )
+
+    # --- restore budget from the access working set (history-driven) ----
+    # The ledger's distinct-byte union rides history as
+    # access_working_set_bytes. Lazy/partial readers touch a working
+    # set far below the restore payload — a budget sized for the whole
+    # payload reserves memory the reads can never fill. 2x the median
+    # working set keeps double-buffering headroom. Skipped on a
+    # 'storage_read' verdict: a read-bound restore wants MORE in
+    # flight, and the rule above already raises the budget.
+    if kind == "restore" and verdict != "storage_read":
+        med_ws = _metric_median(cell, "access_working_set_bytes")
+        med_read = _metric_median(cell, "access_bytes_read")
+        cur_override = knobs.get_memory_budget_override_bytes()
+        if (
+            med_ws
+            and med_bytes
+            and med_ws < 0.5 * med_bytes
+            and (med_read or 0) <= 2 * med_ws
+        ):
+            target = max(int(med_ws * 2), _MIN_RESTORE_BUDGET_BYTES)
+            if cur_override is None or cur_override > 2 * target:
+                knob_list.append(
+                    KnobChange(
+                        env="TPUSNAP_MAX_PER_RANK_MEMORY_BUDGET_BYTES",
+                        value=str(target),
+                        current=(
+                            str(cur_override)
+                            if cur_override is not None
+                            else None
+                        ),
+                        rationale=(
+                            "median access working set is "
+                            f"{int(med_ws)} bytes against a "
+                            f"{int(med_bytes)}-byte median payload — "
+                            "partial readers; size the restore budget "
+                            "to 2x the hot working set instead of the "
+                            "full payload"
                         ),
                     )
                 )
